@@ -1,0 +1,38 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding (pjit /
+shard_map / all_to_all exchanges) is exercised without TPU hardware -- the
+same trick the reference uses with DistributedQueryRunner launching N servers
+in one JVM over loopback (testing/trino-testing/.../DistributedQueryRunner.java:107):
+the full stack runs, only the transport is local.
+
+Env vars MUST be set before jax initializes its backends, hence here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tpch_tiny():
+    """TPC-H tiny (SF 0.01) tables as numpy dicts, generated once per session."""
+    from trino_tpu.connectors.tpch import tpch_data
+    from trino_tpu.connectors.tpch.generator import TPCH_SCHEMAS
+
+    return {t: tpch_data(t, 0.01) for t in TPCH_SCHEMAS}
+
+
+@pytest.fixture(scope="session")
+def oracle(tpch_tiny):
+    """sqlite differential oracle over the same generated data (the
+    reference's H2QueryRunner analogue)."""
+    from tests.oracle import SqliteOracle
+
+    return SqliteOracle(tpch_tiny)

@@ -1,0 +1,172 @@
+"""Differential-testing oracle: sqlite3 over the same generated data.
+
+The reference validates engine results by running every test query on both
+Trino and H2 and diffing (testing/trino-testing/.../AbstractTestQueryFramework.java:344,
+H2QueryRunner).  Here the trusted engine is sqlite (stdlib), loaded with the
+identical numpy tables the TPU engine scans, so any disagreement is an engine
+bug, not a data difference.
+
+sqlite speaks a slightly different dialect; `to_sqlite` rewrites the few
+constructs TPC-H needs (date literals, interval arithmetic, extract,
+substring) so tests keep a single SQL source of truth.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sqlite3
+from typing import Sequence
+
+import numpy as np
+
+from trino_tpu.data.types import DATE, Type, days_to_date
+from trino_tpu.connectors.tpch.generator import TPCH_SCHEMAS
+
+
+def to_sqlite(sql: str) -> str:
+    # date '1994-01-01' [+-] interval 'n' unit  ->  date('1994-01-01', '+n units')
+    def _interval(m: re.Match) -> str:
+        base, sign, n, unit = m.group(1), m.group(2), m.group(3), m.group(4)
+        return f"date({base}, '{sign}{n} {unit}s')"
+
+    out = re.sub(
+        r"date\s+('[\d-]+')\s*([+-])\s*interval\s+'(\d+)'\s+(day|month|year)",
+        _interval,
+        sql,
+        flags=re.IGNORECASE,
+    )
+    # bare date literals
+    out = re.sub(r"\bdate\s+('[\d-]+')", r"\1", out, flags=re.IGNORECASE)
+    # extract(year from x) -> cast(strftime('%Y', x) as integer)
+    out = re.sub(
+        r"extract\s*\(\s*year\s+from\s+([^)]+)\)",
+        r"CAST(strftime('%Y', \1) AS INTEGER)",
+        out,
+        flags=re.IGNORECASE,
+    )
+    out = re.sub(
+        r"extract\s*\(\s*month\s+from\s+([^)]+)\)",
+        r"CAST(strftime('%m', \1) AS INTEGER)",
+        out,
+        flags=re.IGNORECASE,
+    )
+    # substring(x from a for b) -> substr(x, a, b); substring( -> substr(
+    out = re.sub(
+        r"substring\s*\(\s*([^\s,)]+)\s+from\s+(\d+)\s+for\s+(\d+)\s*\)",
+        r"substr(\1, \2, \3)",
+        out,
+        flags=re.IGNORECASE,
+    )
+    out = re.sub(r"\bsubstring\s*\(", "substr(", out, flags=re.IGNORECASE)
+    return out
+
+
+class SqliteOracle:
+    def __init__(self, tables: dict[str, dict[str, np.ndarray]]):
+        self.conn = sqlite3.connect(":memory:")
+        self.conn.create_function("power", 2, lambda a, b: float(a) ** float(b))
+        for name, cols in tables.items():
+            schema = dict(TPCH_SCHEMAS[name])
+            col_defs = ", ".join(f"{c} {_sqlite_type(schema[c])}" for c in cols)
+            self.conn.execute(f"CREATE TABLE {name} ({col_defs})")
+            arrays = []
+            for c, arr in cols.items():
+                if schema[c] == DATE:
+                    arrays.append([days_to_date(int(d)).isoformat() for d in arr])
+                elif arr.dtype == object:
+                    arrays.append([str(v) for v in arr])
+                elif np.issubdtype(arr.dtype, np.floating):
+                    arrays.append([float(v) for v in arr])
+                else:
+                    arrays.append([int(v) for v in arr])
+            rows = list(zip(*arrays))
+            ph = ", ".join("?" for _ in cols)
+            self.conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)
+        self.conn.commit()
+
+    def query(self, sql: str) -> list[tuple]:
+        cur = self.conn.execute(to_sqlite(sql))
+        return [tuple(r) for r in cur.fetchall()]
+
+
+def _sqlite_type(t: Type) -> str:
+    if t.is_string or t == DATE:
+        return "TEXT"
+    if t.is_floating:
+        return "REAL"
+    return "INTEGER"
+
+
+def assert_rows_equal(
+    actual: Sequence[tuple],
+    expected: Sequence[tuple],
+    ordered: bool = False,
+    rtol: float = 1e-9,
+    atol: float = 1e-6,
+) -> None:
+    """Diff two result sets with float tolerance (sum order differs between
+    engines, so exact float equality is not meaningful)."""
+    assert len(actual) == len(expected), (
+        f"row count mismatch: {len(actual)} vs {len(expected)}\n"
+        f"actual[:5]={list(actual)[:5]}\nexpected[:5]={list(expected)[:5]}"
+    )
+    a, e = list(actual), list(expected)
+    if not ordered:
+        a = sorted(a, key=_sort_key)
+        e = sorted(e, key=_sort_key)
+    mismatch = _first_mismatch(a, e, rtol, atol)
+    if mismatch is not None and not ordered:
+        # Rounding in the sort key can misalign rows whose floats are equal
+        # within tolerance but round differently; fall back to greedy
+        # tolerant matching (result sets here are small).
+        unmatched = list(range(len(e)))
+        for i, ra in enumerate(a):
+            hit = next(
+                (k for k in unmatched if _rows_close(ra, e[k], rtol, atol)), None
+            )
+            assert hit is not None, f"no expected row matches actual row {i}: {ra}\n{mismatch}"
+            unmatched.remove(hit)
+        return
+    assert mismatch is None, mismatch
+
+
+def _first_mismatch(a, e, rtol, atol):
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        if len(ra) != len(re_):
+            return f"row {i}: arity {len(ra)} vs {len(re_)}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            if not _vals_close(va, ve, rtol, atol):
+                return (
+                    f"row {i} col {j}: {va!r} vs {ve!r}\nactual row: {ra}\nexpected row: {re_}"
+                )
+    return None
+
+
+def _vals_close(va, ve, rtol, atol) -> bool:
+    if va is None or ve is None:
+        return va is None and ve is None
+    if isinstance(va, float) or isinstance(ve, float):
+        try:
+            return math.isclose(float(va), float(ve), rel_tol=rtol, abs_tol=atol)
+        except (TypeError, ValueError):
+            return False
+    return va == ve
+
+
+def _rows_close(ra, re_, rtol, atol) -> bool:
+    return len(ra) == len(re_) and all(_vals_close(x, y, rtol, atol) for x, y in zip(ra, re_))
+
+
+def _sort_key(row: tuple):
+    return tuple((v is None, _norm(v)) for v in row)
+
+
+def _norm(v):
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return round(v, 6)
+    if isinstance(v, int):
+        return float(v)
+    return str(v)

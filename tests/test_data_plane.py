@@ -1,0 +1,95 @@
+"""L0 data plane tests: types, pages, dictionary encoding, TPC-H generator."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.data.page import Column, Dictionary, Page
+from trino_tpu.data.types import BIGINT, DATE, DOUBLE, VARCHAR, date_to_days, parse_type
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.connectors.tpch.generator import TPCH_SCHEMAS
+
+
+def test_parse_type():
+    assert parse_type("bigint") is BIGINT
+    assert parse_type("varchar(25)").is_string
+    assert parse_type("decimal(12,2)").scale == 2
+
+
+def test_dictionary_roundtrip():
+    codes, d = Dictionary.encode(["b", "a", "b", "c"])
+    assert [d.values[c] for c in codes] == ["b", "a", "b", "c"]
+    assert d.code_of("c") == codes[3]
+    assert d.code_of("zzz") == -1
+    mask = d.mask_where(lambda v: v >= "b")
+    assert list(mask[codes]) == [True, False, True, True]
+
+
+def test_page_to_pylist_with_live_mask():
+    import jax.numpy as jnp
+
+    page = Page.from_numpy(
+        [BIGINT, DOUBLE, VARCHAR, DATE],
+        [
+            np.array([1, 2, 3]),
+            np.array([1.5, 2.5, 3.5]),
+            np.array(["x", "y", "x"], dtype=object),
+            np.array([date_to_days("1994-01-01")] * 3),
+        ],
+    )
+    page = page.with_live(jnp.asarray(np.array([True, False, True])))
+    rows = page.to_pylist()
+    assert rows == [(1, 1.5, "x", "1994-01-01"), (3, 3.5, "x", "1994-01-01")]
+    assert int(page.row_count()) == 2
+
+
+def test_tpch_generator_shapes(tpch_tiny):
+    assert len(tpch_tiny["region"]["r_regionkey"]) == 5
+    assert len(tpch_tiny["nation"]["n_nationkey"]) == 25
+    assert len(tpch_tiny["orders"]["o_orderkey"]) == 15_000
+    n_lines = len(tpch_tiny["lineitem"]["l_orderkey"])
+    assert 45_000 < n_lines < 75_000
+    # schema columns all present, deterministic regeneration
+    for t, schema in TPCH_SCHEMAS.items():
+        assert set(tpch_tiny[t]) == {c for c, _ in schema}
+    from trino_tpu.connectors.tpch.generator import generate_table
+
+    again = generate_table("supplier", 0.01)
+    assert np.array_equal(again["s_acctbal"], tpch_tiny["supplier"]["s_acctbal"])
+
+
+def test_tpch_orders_lineitem_consistency(tpch_tiny):
+    """o_totalprice must equal the sum over the order's lines."""
+    li, od = tpch_tiny["lineitem"], tpch_tiny["orders"]
+    line_total = np.round(li["l_extendedprice"] * (1 + li["l_tax"]) * (1 - li["l_discount"]), 2)
+    keys = {k: i for i, k in enumerate(od["o_orderkey"])}
+    sums = np.zeros(len(od["o_orderkey"]))
+    for k, v in zip(li["l_orderkey"], line_total):
+        sums[keys[k]] += v
+    assert np.allclose(np.round(sums, 2), od["o_totalprice"], atol=0.05)
+
+
+def test_connector_splits(tpch_tiny):
+    conn = TpchConnector(0.01)
+    splits = conn.get_splits("orders", 4)
+    assert len(splits) == 4
+    parts = [conn.read_split(s, ["o_orderkey"]) for s in splits]
+    combined = np.concatenate([p["o_orderkey"] for p in parts])
+    assert np.array_equal(combined, tpch_tiny["orders"]["o_orderkey"])
+
+
+def test_oracle_basics(oracle):
+    (count,) = oracle.query("SELECT count(*) FROM nation")[0]
+    assert count == 25
+    rows = oracle.query(
+        "SELECT n_name FROM nation, region WHERE n_regionkey = r_regionkey AND r_name = 'ASIA'"
+    )
+    assert {r[0] for r in rows} == {"INDIA", "INDONESIA", "JAPAN", "CHINA", "VIETNAM"}
+
+
+def test_oracle_translation():
+    from tests.oracle import to_sqlite
+
+    out = to_sqlite("SELECT * FROM t WHERE d < date '1994-01-01' + interval '1' year")
+    assert "date('1994-01-01', '+1 years')" in out
+    out = to_sqlite("SELECT extract(year from o_orderdate) FROM orders")
+    assert "strftime" in out

@@ -1,0 +1,25 @@
+"""trino_tpu: a TPU-native distributed SQL query engine.
+
+A from-scratch reimplementation of the capabilities of Trino (the reference
+coordinator/worker MPP SQL engine) designed TPU-first:
+
+- Columnar data plane as HBM-resident struct-of-arrays with validity masks
+  (the reference's Page/Block hierarchy, core/trino-spi/src/main/java/io/trino/spi/Page.java).
+- Physical operators (scan/filter/project, hash aggregation, hash join, TopN,
+  sort, window) as jax.jit-compiled batch kernels and Pallas kernels instead of
+  the reference's virtual-call pull loops (operator/Driver.java).
+- Runtime codegen (the reference's sql/gen bytecode compiler) becomes jax
+  tracing + an XLA compile cache keyed by (fragment, shape class).
+- Repartition exchanges map onto XLA all_to_all/all_gather over ICI inside a
+  jitted step (the reference's HTTP exchange, operator/DirectExchangeClient.java),
+  with a host gRPC/HTTP data plane across slices.
+
+SQL engines need exact-ish numerics: we enable 64-bit mode globally so BIGINT
+is int64 and DOUBLE is float64 (both supported on TPU v5e).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

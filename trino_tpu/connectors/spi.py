@@ -1,0 +1,101 @@
+"""Connector SPI: how table data enters the engine.
+
+Mirrors the reference's plugin surface (core/trino-spi/src/main/java/io/trino/
+spi/connector/: Connector, ConnectorMetadata, ConnectorSplitManager,
+ConnectorPageSource) reduced to the TPU data flow: connectors enumerate
+*splits* (host-side row ranges), and each split materializes as numpy column
+arrays that the executor uploads to HBM as a Page.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..data.types import Type
+
+__all__ = ["ColumnSchema", "TableSchema", "Split", "Connector", "CatalogManager"]
+
+
+@dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    type: Type
+
+
+@dataclass(frozen=True)
+class TableSchema:
+    name: str
+    columns: tuple[ColumnSchema, ...]
+
+    def column_names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def type_of(self, name: str) -> Type:
+        return self.columns[self.column_index(name)].type
+
+
+@dataclass(frozen=True)
+class Split:
+    """A unit of scan parallelism (reference: spi/connector/ConnectorSplit).
+
+    `part`/`num_parts` partition the table by row range; the scheduler assigns
+    splits to workers (reference: NodeScheduler.java:51).
+    """
+
+    catalog: str
+    table: str
+    part: int
+    num_parts: int
+
+
+class Connector(abc.ABC):
+    """A data source (reference: spi/Plugin.java -> ConnectorFactory)."""
+
+    name: str
+
+    @abc.abstractmethod
+    def list_tables(self) -> list[str]: ...
+
+    @abc.abstractmethod
+    def table_schema(self, table: str) -> TableSchema: ...
+
+    @abc.abstractmethod
+    def get_splits(self, table: str, desired_parts: int) -> list[Split]: ...
+
+    @abc.abstractmethod
+    def read_split(
+        self, split: Split, columns: Sequence[str]
+    ) -> dict[str, np.ndarray]:
+        """Materialize the requested columns of a split as host arrays."""
+
+    def estimated_row_count(self, table: str) -> Optional[int]:
+        """Optional stats for the cost-based optimizer."""
+        return None
+
+
+class CatalogManager:
+    """Registry of named catalogs (reference: metadata/CatalogManager)."""
+
+    def __init__(self) -> None:
+        self._catalogs: dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str) -> Connector:
+        if name not in self._catalogs:
+            raise KeyError(f"catalog not registered: {name}")
+        return self._catalogs[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._catalogs)

@@ -1,0 +1,461 @@
+"""Deterministic TPC-H data generator (numpy, vectorized).
+
+The reference ships TPC-H as a connector over a deterministic generator
+(plugin/trino-tpch: TpchMetadata, TpchSplitManager, TpchPageSource) and uses it
+as the benchmark/test workhorse.  This is a from-scratch numpy implementation
+of the same idea: spec-shaped schemas, cardinalities and value distributions
+(TPC-H v3 clause 4.2), seeded PCG64 so every run -- and every split of every
+run -- produces identical data.  Correctness testing is differential (engine
+vs sqlite over the *same* generated rows), so spec-exact dbgen bit-equality is
+not required; distribution shape is, because the 22 queries' selectivities
+depend on it.
+
+Column types follow the reference's default DOUBLE decimal mapping
+(plugin/trino-tpch TpchMetadata: DecimalTypeMapping.DOUBLE).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ...data.types import BIGINT, DATE, DOUBLE, INTEGER, VARCHAR, Type, date_to_days
+
+__all__ = ["TPCH_SCHEMAS", "generate_table", "table_row_count", "SCALE_TINY"]
+
+SCALE_TINY = 0.01
+
+_SEED = 0x7C9E_2025
+
+TPCH_SCHEMAS: dict[str, list[tuple[str, Type]]] = {
+    "region": [("r_regionkey", BIGINT), ("r_name", VARCHAR), ("r_comment", VARCHAR)],
+    "nation": [
+        ("n_nationkey", BIGINT),
+        ("n_name", VARCHAR),
+        ("n_regionkey", BIGINT),
+        ("n_comment", VARCHAR),
+    ],
+    "supplier": [
+        ("s_suppkey", BIGINT),
+        ("s_name", VARCHAR),
+        ("s_address", VARCHAR),
+        ("s_nationkey", BIGINT),
+        ("s_phone", VARCHAR),
+        ("s_acctbal", DOUBLE),
+        ("s_comment", VARCHAR),
+    ],
+    "part": [
+        ("p_partkey", BIGINT),
+        ("p_name", VARCHAR),
+        ("p_mfgr", VARCHAR),
+        ("p_brand", VARCHAR),
+        ("p_type", VARCHAR),
+        ("p_size", INTEGER),
+        ("p_container", VARCHAR),
+        ("p_retailprice", DOUBLE),
+        ("p_comment", VARCHAR),
+    ],
+    "partsupp": [
+        ("ps_partkey", BIGINT),
+        ("ps_suppkey", BIGINT),
+        ("ps_availqty", INTEGER),
+        ("ps_supplycost", DOUBLE),
+        ("ps_comment", VARCHAR),
+    ],
+    "customer": [
+        ("c_custkey", BIGINT),
+        ("c_name", VARCHAR),
+        ("c_address", VARCHAR),
+        ("c_nationkey", BIGINT),
+        ("c_phone", VARCHAR),
+        ("c_acctbal", DOUBLE),
+        ("c_mktsegment", VARCHAR),
+        ("c_comment", VARCHAR),
+    ],
+    "orders": [
+        ("o_orderkey", BIGINT),
+        ("o_custkey", BIGINT),
+        ("o_orderstatus", VARCHAR),
+        ("o_totalprice", DOUBLE),
+        ("o_orderdate", DATE),
+        ("o_orderpriority", VARCHAR),
+        ("o_clerk", VARCHAR),
+        ("o_shippriority", INTEGER),
+        ("o_comment", VARCHAR),
+    ],
+    "lineitem": [
+        ("l_orderkey", BIGINT),
+        ("l_partkey", BIGINT),
+        ("l_suppkey", BIGINT),
+        ("l_linenumber", INTEGER),
+        ("l_quantity", DOUBLE),
+        ("l_extendedprice", DOUBLE),
+        ("l_discount", DOUBLE),
+        ("l_tax", DOUBLE),
+        ("l_returnflag", VARCHAR),
+        ("l_linestatus", VARCHAR),
+        ("l_shipdate", DATE),
+        ("l_commitdate", DATE),
+        ("l_receiptdate", DATE),
+        ("l_shipinstruct", VARCHAR),
+        ("l_shipmode", VARCHAR),
+        ("l_comment", VARCHAR),
+    ],
+}
+
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_NATIONS = [  # (name, regionkey) -- TPC-H spec fixed table
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+_MODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+_CONTAINERS1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+_CONTAINERS2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+_TYPES1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+_TYPES2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+_TYPES3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+# P_NAME words: TPC-H colors list (subset incl. ones queries filter on).
+_COLORS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black", "blanched",
+    "blue", "blush", "brown", "burlywood", "burnished", "chartreuse", "chiffon",
+    "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan", "dark", "deep",
+    "dim", "dodger", "drab", "firebrick", "floral", "forest", "frosted", "gainsboro",
+    "ghost", "goldenrod", "green", "grey", "honeydew", "hot", "indian", "ivory",
+    "khaki", "lace", "lavender", "lawn", "lemon", "light", "lime", "linen", "magenta",
+    "maroon", "medium", "metallic", "midnight", "mint", "misty", "moccasin", "navajo",
+    "navy", "olive", "orange", "orchid", "pale", "papaya", "peach", "peru", "pink",
+    "plum", "powder", "puff", "purple", "red", "rose", "rosy", "royal", "saddle",
+    "salmon", "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow",
+    "spring", "steel", "tan", "thistle", "tomato", "turquoise", "violet", "wheat",
+    "white", "yellow",
+]
+_WORDS = [  # comment vocabulary
+    "carefully", "furiously", "quickly", "slyly", "blithely", "final", "special",
+    "express", "regular", "unusual", "ironic", "pending", "bold", "even", "silent",
+    "requests", "deposits", "packages", "accounts", "instructions", "theodolites",
+    "foxes", "pinto", "beans", "dependencies", "excuses", "platelets", "asymptotes",
+    "courts", "dolphins", "multipliers", "sauternes", "warthogs", "frets", "dinos",
+]
+
+_STARTDATE = date_to_days("1992-01-01")
+_CURRENTDATE = date_to_days("1995-06-17")
+_ENDDATE = date_to_days("1998-12-31")
+
+
+def table_row_count(table: str, scale: float) -> int:
+    base = {
+        "region": 5,
+        "nation": 25,
+        "supplier": 10_000,
+        "part": 200_000,
+        "partsupp": 800_000,
+        "customer": 150_000,
+        "orders": 1_500_000,
+    }
+    if table in ("region", "nation"):
+        return base[table]
+    if table == "lineitem":
+        # lines are generated per-order (1..7); callers should not rely on an
+        # exact count -- use generate_table and read the arrays' length.
+        return int(base["orders"] * scale) * 4
+    return max(1, int(base[table] * scale))
+
+
+def _rng(table: str, scale: float, part: int = 0) -> np.random.Generator:
+    # zlib.crc32 is stable across processes (unlike hash(), which PYTHONHASHSEED
+    # randomizes) -- determinism across runs is part of the generator contract.
+    table_tag = zlib.crc32(table.encode())
+    return np.random.Generator(np.random.PCG64([_SEED, table_tag, int(scale * 1e6), part]))
+
+
+def _comments(rng: np.random.Generator, n: int, nwords: int = 4) -> np.ndarray:
+    words = np.asarray(_WORDS, dtype=object)
+    picks = rng.integers(0, len(words), size=(n, nwords))
+    out = words[picks[:, 0]]
+    for i in range(1, nwords):
+        out = out + " " + words[picks[:, i]]
+    return out
+
+
+def _money(rng: np.random.Generator, n: int, lo: float, hi: float) -> np.ndarray:
+    """Cents-quantized uniform doubles (all TPC-H money is 2-decimal)."""
+    cents = rng.integers(int(lo * 100), int(hi * 100) + 1, size=n)
+    return cents / 100.0
+
+
+def _retail_price(partkey: np.ndarray) -> np.ndarray:
+    # TPC-H spec 4.2.3: (90000 + ((partkey/10) mod 20001) + 100*(partkey mod 1000)) / 100
+    return (90000 + (partkey // 10) % 20001 + 100 * (partkey % 1000)) / 100.0
+
+
+def _supp_for_part(partkey: np.ndarray, i: np.ndarray, num_supp: int, scale: float) -> np.ndarray:
+    # spec 4.2.3 partsupp: ps_suppkey = (ps_partkey + (i * (S/4 + (ps_partkey-1)/S))) mod S + 1
+    s = num_supp
+    return (partkey + i * (s // 4 + (partkey - 1) // s)) % s + 1
+
+
+def generate_table(table: str, scale: float) -> dict[str, np.ndarray]:
+    """Generate a full table as {column_name: numpy array} (object dtype for strings)."""
+    fn = {
+        "region": _gen_region,
+        "nation": _gen_nation,
+        "supplier": _gen_supplier,
+        "part": _gen_part,
+        "partsupp": _gen_partsupp,
+        "customer": _gen_customer,
+        "orders": _gen_orders,
+        "lineitem": _gen_lineitem,
+    }[table]
+    return fn(scale)
+
+
+def _gen_region(scale: float) -> dict[str, np.ndarray]:
+    rng = _rng("region", scale)
+    return {
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": np.asarray(_REGIONS, dtype=object),
+        "r_comment": _comments(rng, 5),
+    }
+
+
+def _gen_nation(scale: float) -> dict[str, np.ndarray]:
+    rng = _rng("nation", scale)
+    return {
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": np.asarray([n for n, _ in _NATIONS], dtype=object),
+        "n_regionkey": np.asarray([r for _, r in _NATIONS], dtype=np.int64),
+        "n_comment": _comments(rng, 25),
+    }
+
+
+def _gen_supplier(scale: float) -> dict[str, np.ndarray]:
+    n = table_row_count("supplier", scale)
+    rng = _rng("supplier", scale)
+    key = np.arange(1, n + 1, dtype=np.int64)
+    nation = rng.integers(0, 25, size=n).astype(np.int64)
+    comments = _comments(rng, n)
+    # Q16: some suppliers have 'Customer ... Complaints' comments (spec: 5 per SF*10000/2... keep ~0.05%)
+    bad = rng.random(n) < 0.0005
+    comments = comments.copy()
+    comments[bad] = "take Customer heed Complaints carefully"
+    phone = _phones(rng, nation)
+    return {
+        "s_suppkey": key,
+        "s_name": np.asarray([f"Supplier#{k:09d}" for k in key], dtype=object),
+        "s_address": _comments(rng, n, 2),
+        "s_nationkey": nation,
+        "s_phone": phone,
+        "s_acctbal": _money(rng, n, -999.99, 9999.99),
+        "s_comment": comments,
+    }
+
+
+def _phones(rng: np.random.Generator, nation: np.ndarray) -> np.ndarray:
+    n = len(nation)
+    cc = (nation + 10).astype(np.int64)
+    a = rng.integers(100, 1000, size=n)
+    b = rng.integers(100, 1000, size=n)
+    c = rng.integers(1000, 10000, size=n)
+    return np.asarray([f"{cc[i]}-{a[i]}-{b[i]}-{c[i]}" for i in range(n)], dtype=object)
+
+
+def _gen_part(scale: float) -> dict[str, np.ndarray]:
+    n = table_row_count("part", scale)
+    rng = _rng("part", scale)
+    key = np.arange(1, n + 1, dtype=np.int64)
+    colors = np.asarray(_COLORS, dtype=object)
+    picks = rng.integers(0, len(colors), size=(n, 5))
+    name = colors[picks[:, 0]]
+    for i in range(1, 5):
+        name = name + " " + colors[picks[:, i]]
+    mfgr_i = rng.integers(1, 6, size=n)
+    brand_i = mfgr_i * 10 + rng.integers(1, 6, size=n)
+    t1 = np.asarray(_TYPES1, dtype=object)[rng.integers(0, len(_TYPES1), size=n)]
+    t2 = np.asarray(_TYPES2, dtype=object)[rng.integers(0, len(_TYPES2), size=n)]
+    t3 = np.asarray(_TYPES3, dtype=object)[rng.integers(0, len(_TYPES3), size=n)]
+    c1 = np.asarray(_CONTAINERS1, dtype=object)[rng.integers(0, len(_CONTAINERS1), size=n)]
+    c2 = np.asarray(_CONTAINERS2, dtype=object)[rng.integers(0, len(_CONTAINERS2), size=n)]
+    return {
+        "p_partkey": key,
+        "p_name": name,
+        "p_mfgr": np.asarray([f"Manufacturer#{i}" for i in mfgr_i], dtype=object),
+        "p_brand": np.asarray([f"Brand#{i}" for i in brand_i], dtype=object),
+        "p_type": t1 + " " + t2 + " " + t3,
+        "p_size": rng.integers(1, 51, size=n).astype(np.int32),
+        "p_container": c1 + " " + c2,
+        "p_retailprice": _retail_price(key),
+        "p_comment": _comments(rng, n, 2),
+    }
+
+
+def _gen_partsupp(scale: float) -> dict[str, np.ndarray]:
+    nparts = table_row_count("part", scale)
+    nsupp = table_row_count("supplier", scale)
+    rng = _rng("partsupp", scale)
+    partkey = np.repeat(np.arange(1, nparts + 1, dtype=np.int64), 4)
+    i = np.tile(np.arange(4, dtype=np.int64), nparts)
+    suppkey = _supp_for_part(partkey, i, nsupp, scale)
+    n = len(partkey)
+    return {
+        "ps_partkey": partkey,
+        "ps_suppkey": suppkey,
+        "ps_availqty": rng.integers(1, 10_000, size=n).astype(np.int32),
+        "ps_supplycost": _money(rng, n, 1.00, 1000.00),
+        "ps_comment": _comments(rng, n, 3),
+    }
+
+
+def _gen_customer(scale: float) -> dict[str, np.ndarray]:
+    n = table_row_count("customer", scale)
+    rng = _rng("customer", scale)
+    key = np.arange(1, n + 1, dtype=np.int64)
+    nation = rng.integers(0, 25, size=n).astype(np.int64)
+    return {
+        "c_custkey": key,
+        "c_name": np.asarray([f"Customer#{k:09d}" for k in key], dtype=object),
+        "c_address": _comments(rng, n, 2),
+        "c_nationkey": nation,
+        "c_phone": _phones(rng, nation),
+        "c_acctbal": _money(rng, n, -999.99, 9999.99),
+        "c_mktsegment": np.asarray(_SEGMENTS, dtype=object)[rng.integers(0, 5, size=n)],
+        "c_comment": _comments(rng, n, 4),
+    }
+
+
+_ORDER_LINES_CACHE: dict[float, dict] = {}
+
+
+def _order_lines(scale: float):
+    """Shared orders+lineitem generation (o_totalprice / o_orderstatus are
+    aggregates of the order's lines, TPC-H spec 4.2.3).  Cached per scale:
+    both tables derive from one generation pass."""
+    if scale in _ORDER_LINES_CACHE:
+        return _ORDER_LINES_CACHE[scale]
+    g = _order_lines_uncached(scale)
+    _ORDER_LINES_CACHE[scale] = g
+    return g
+
+
+def _order_lines_uncached(scale: float):
+    norders = table_row_count("orders", scale)
+    ncust = table_row_count("customer", scale)
+    npart = table_row_count("part", scale)
+    nsupp = table_row_count("supplier", scale)
+    rng = _rng("orders", scale)
+
+    # sparse orderkeys: 8 used out of each 32-key block (spec 4.2.3)
+    i = np.arange(norders, dtype=np.int64)
+    orderkey = (i // 8) * 32 + (i % 8) + 1
+    # custkey skips every third customer (spec: c_custkey % 3 != 0)
+    ck = rng.integers(1, ncust + 1, size=norders).astype(np.int64)
+    ck = np.where(ck % 3 == 0, (ck % ncust) + 1, ck)
+    ck = np.where(ck % 3 == 0, (ck % ncust) + 2, ck)
+    ck = np.where(ck % 3 == 0, 1 if ncust < 3 else 2, ck)
+    orderdate = rng.integers(_STARTDATE, _ENDDATE - 151 + 1, size=norders).astype(np.int32)
+
+    nlines = rng.integers(1, 8, size=norders)
+    total_lines = int(nlines.sum())
+    oidx = np.repeat(np.arange(norders), nlines)  # order index per line
+    linenumber = (np.arange(total_lines) - np.repeat(np.cumsum(nlines) - nlines, nlines) + 1).astype(np.int32)
+
+    lrng = _rng("lineitem", scale)
+    partkey = lrng.integers(1, npart + 1, size=total_lines).astype(np.int64)
+    suppkey = _supp_for_part(partkey, lrng.integers(0, 4, size=total_lines).astype(np.int64), nsupp, scale)
+    quantity = lrng.integers(1, 51, size=total_lines).astype(np.float64)
+    extprice = np.round(quantity * _retail_price(partkey), 2)
+    discount = lrng.integers(0, 11, size=total_lines) / 100.0
+    tax = lrng.integers(0, 9, size=total_lines) / 100.0
+    l_orderdate = orderdate[oidx].astype(np.int64)
+    shipdate = (l_orderdate + lrng.integers(1, 122, size=total_lines)).astype(np.int32)
+    commitdate = (l_orderdate + lrng.integers(30, 91, size=total_lines)).astype(np.int32)
+    receiptdate = (shipdate + lrng.integers(1, 31, size=total_lines)).astype(np.int32)
+    returnflag = np.where(
+        receiptdate <= _CURRENTDATE,
+        np.where(lrng.random(total_lines) < 0.5, "R", "A"),
+        "N",
+    ).astype(object)
+    linestatus = np.where(shipdate > _CURRENTDATE, "O", "F").astype(object)
+
+    return {
+        "norders": norders,
+        "orderkey": orderkey,
+        "custkey": ck,
+        "orderdate": orderdate,
+        "nlines": nlines,
+        "oidx": oidx,
+        "linenumber": linenumber,
+        "partkey": partkey,
+        "suppkey": suppkey,
+        "quantity": quantity,
+        "extprice": extprice,
+        "discount": discount,
+        "tax": tax,
+        "shipdate": shipdate,
+        "commitdate": commitdate,
+        "receiptdate": receiptdate,
+        "returnflag": returnflag,
+        "linestatus": linestatus,
+    }
+
+
+def _gen_orders(scale: float) -> dict[str, np.ndarray]:
+    g = _order_lines(scale)
+    norders = g["norders"]
+    # fresh stream (part=1): the cached _order_lines dict must stay free of
+    # live RNG state so repeated generation is idempotent
+    rng = _rng("orders", scale, part=1)
+    line_total = np.round(g["extprice"] * (1 + g["tax"]) * (1 - g["discount"]), 2)
+    totalprice = np.round(np.bincount(g["oidx"], weights=line_total, minlength=norders), 2)
+    open_lines = np.bincount(g["oidx"], weights=(g["linestatus"] == "O").astype(float), minlength=norders)
+    status = np.where(open_lines == 0, "F", np.where(open_lines == g["nlines"], "O", "P")).astype(object)
+    comments = _comments(rng, norders, 4)
+    # Q13 filters o_comment NOT LIKE '%special%requests%'
+    has_special = rng.random(norders) < 0.01
+    comments = comments.copy()
+    comments[has_special] = "blithely special packages requests sleep"
+    clerk = np.asarray(
+        [f"Clerk#{k:09d}" for k in rng.integers(1, max(2, int(1000 * scale)) + 1, size=norders)], dtype=object
+    )
+    return {
+        "o_orderkey": g["orderkey"],
+        "o_custkey": g["custkey"],
+        "o_orderstatus": status,
+        "o_totalprice": totalprice,
+        "o_orderdate": g["orderdate"],
+        "o_orderpriority": np.asarray(_PRIORITIES, dtype=object)[rng.integers(0, 5, size=norders)],
+        "o_clerk": clerk,
+        "o_shippriority": np.zeros(norders, dtype=np.int32),
+        "o_comment": comments,
+    }
+
+
+def _gen_lineitem(scale: float) -> dict[str, np.ndarray]:
+    g = _order_lines(scale)
+    lrng = _rng("lineitem", scale, part=1)
+    total_lines = len(g["partkey"])
+    return {
+        "l_orderkey": g["orderkey"][g["oidx"]],
+        "l_partkey": g["partkey"],
+        "l_suppkey": g["suppkey"],
+        "l_linenumber": g["linenumber"],
+        "l_quantity": g["quantity"],
+        "l_extendedprice": g["extprice"],
+        "l_discount": g["discount"],
+        "l_tax": g["tax"],
+        "l_returnflag": g["returnflag"],
+        "l_linestatus": g["linestatus"],
+        "l_shipdate": g["shipdate"],
+        "l_commitdate": g["commitdate"],
+        "l_receiptdate": g["receiptdate"],
+        "l_shipinstruct": np.asarray(_INSTRUCTS, dtype=object)[lrng.integers(0, 4, size=total_lines)],
+        "l_shipmode": np.asarray(_MODES, dtype=object)[lrng.integers(0, 7, size=total_lines)],
+        "l_comment": _comments(lrng, total_lines, 2),
+    }

@@ -1,0 +1,208 @@
+"""Columnar data plane: device-resident pages.
+
+The reference's unit of data flow is the Page -- an immutable list of columnar
+Blocks plus a position count (spi/Page.java:31, spi/block/Block.java:21).
+The TPU equivalent keeps the page concept but lowers it to a struct-of-arrays
+in HBM with static capacity:
+
+- every column is one fixed-width dtype array (spi/block/LongArrayBlock etc.)
+- NULLs are a per-column bool validity mask (the reference's isNull bitmap)
+- a page-level `live` bool mask marks which of the `capacity` rows logically
+  exist.  Filters set the mask instead of compacting, so every kernel sees
+  static shapes and XLA never re-specializes on selectivity; this replaces the
+  reference's SelectedPositions machinery (operator/project/SelectedPositions.java).
+- VARCHAR columns are int32 codes plus a host-side Dictionary (the reference's
+  DictionaryBlock made mandatory; see data/types.py).
+
+Pages are registered as JAX pytrees so whole operator pipelines jit end-to-end.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import BOOLEAN, DATE, Type, days_to_date
+
+__all__ = ["Dictionary", "Column", "Page"]
+
+
+class Dictionary:
+    """Host-side string dictionary for a VARCHAR column.
+
+    Identity-hashed so it can ride in jit cache keys as static metadata:
+    dictionaries are built once at ingest and shared by reference.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: np.ndarray):
+        self.values = np.asarray(values, dtype=object)
+        self._index: Optional[dict] = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, value: str) -> int:
+        """Return the code for ``value``, or -1 if absent."""
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.values)}
+        return self._index.get(value, -1)
+
+    def mask_where(self, predicate) -> np.ndarray:
+        """Evaluate a host predicate over dictionary values -> bool[len].
+
+        This is how string predicates (LIKE, comparisons) run: evaluate once
+        on the (small) dictionary on host, then gather the mask by code on
+        device.  The reference evaluates per row; per-distinct-value is the
+        dictionary-aware fast path (DictionaryAwarePageProjection.java).
+        """
+        return np.array([bool(predicate(v)) for v in self.values], dtype=np.bool_)
+
+    def sorted_rank(self) -> np.ndarray:
+        """rank[code] = rank of the value in sorted order, for ORDER BY."""
+        order = np.argsort(self.values, kind="stable")
+        rank = np.empty(len(self.values), dtype=np.int32)
+        rank[order] = np.arange(len(self.values), dtype=np.int32)
+        return rank
+
+    @staticmethod
+    def encode(values: Sequence[str]) -> tuple[np.ndarray, "Dictionary"]:
+        arr = np.asarray(values, dtype=object)
+        uniq, codes = np.unique(arr, return_inverse=True)
+        return codes.astype(np.int32), Dictionary(uniq)
+
+    def __repr__(self) -> str:
+        return f"Dictionary({len(self.values)} values)"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Column:
+    """One column of a page: device data + optional validity + optional dict."""
+
+    type: Type
+    data: jnp.ndarray
+    valid: Optional[jnp.ndarray] = None  # bool mask; None == all valid
+    dictionary: Optional[Dictionary] = None
+
+    def tree_flatten(self):
+        children = (self.data, self.valid)
+        return children, (self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, valid = children
+        type_, dictionary = aux
+        return cls(type_, data, valid, dictionary)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @staticmethod
+    def from_numpy(type_: Type, values: np.ndarray, valid: Optional[np.ndarray] = None) -> "Column":
+        if type_.is_string:
+            codes, dictionary = Dictionary.encode(values)
+            return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
+        return Column(
+            type_,
+            jnp.asarray(np.asarray(values, dtype=type_.np_dtype)),
+            None if valid is None else jnp.asarray(valid),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Page:
+    """A fixed-capacity horizontal slice of a relation (spi/Page.java:31)."""
+
+    columns: tuple[Column, ...]
+    live: Optional[jnp.ndarray] = None  # bool[capacity]; None == all rows live
+
+    def tree_flatten(self):
+        return (self.columns, self.live), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, live = children
+        return cls(tuple(columns), live)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def live_mask(self) -> jnp.ndarray:
+        if self.live is None:
+            return jnp.ones((self.capacity,), dtype=jnp.bool_)
+        return self.live
+
+    def row_count(self) -> jnp.ndarray:
+        """Number of live rows (device scalar)."""
+        if self.live is None:
+            return jnp.int32(self.capacity)
+        return jnp.sum(self.live, dtype=jnp.int32)
+
+    def with_live(self, live: Optional[jnp.ndarray]) -> "Page":
+        return Page(self.columns, live)
+
+    def select_columns(self, indices: Sequence[int]) -> "Page":
+        return Page(tuple(self.columns[i] for i in indices), self.live)
+
+    # -- host-side materialization (result sets, test assertions) -----------
+    def to_pylist(self) -> list[tuple]:
+        """Compact live rows to host as Python tuples (None for NULL)."""
+        live = np.asarray(self.live_mask())
+        idx = np.nonzero(live)[0]
+        cols: list[np.ndarray] = []
+        valids: list[Optional[np.ndarray]] = []
+        pys: list[Any] = []
+        for col in self.columns:
+            data = np.asarray(col.data)[idx]
+            valid = None if col.valid is None else np.asarray(col.valid)[idx]
+            if col.type.is_string:
+                vals = col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))] if len(idx) else np.array([], dtype=object)
+                pys.append(vals)
+            elif col.type == DATE:
+                pys.append(np.array([days_to_date(d).isoformat() for d in data], dtype=object))
+            elif col.type == BOOLEAN:
+                pys.append(data.astype(bool))
+            elif col.type.is_floating:
+                pys.append(data.astype(float))
+            else:
+                pys.append(data)
+            valids.append(valid)
+        rows = []
+        for r in range(len(idx)):
+            rows.append(
+                tuple(
+                    None if (valids[c] is not None and not valids[c][r]) else _pyval(pys[c][r])
+                    for c in range(len(self.columns))
+                )
+            )
+        return rows
+
+    @staticmethod
+    def from_numpy(types: Sequence[Type], arrays: Sequence[np.ndarray]) -> "Page":
+        assert len(types) == len(arrays)
+        lengths = {len(a) for a in arrays}
+        assert len(lengths) <= 1, f"ragged page: column lengths {sorted(lengths)}"
+        return Page(tuple(Column.from_numpy(t, a) for t, a in zip(types, arrays)))
+
+
+def _pyval(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return v

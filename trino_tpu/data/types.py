@@ -1,0 +1,167 @@
+"""SQL type system.
+
+The reference models types as a class hierarchy with per-type block layouts
+(core/trino-spi/src/main/java/io/trino/spi/type/, 82 files). On TPU every
+type lowers to a fixed-width device dtype; variable-width VARCHAR is
+dictionary-encoded at ingest (int32 codes into a host-side dictionary), which
+is also how the reference's DictionaryBlock works
+(spi/block/DictionaryBlock.java) -- here it is the *only* device
+representation, because the MXU/VPU want fixed-width lanes.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "Type",
+    "BIGINT",
+    "INTEGER",
+    "SMALLINT",
+    "TINYINT",
+    "DOUBLE",
+    "REAL",
+    "BOOLEAN",
+    "DATE",
+    "VARCHAR",
+    "TIMESTAMP",
+    "DecimalType",
+    "UNKNOWN",
+    "date_to_days",
+    "days_to_date",
+    "parse_type",
+]
+
+
+@dataclass(frozen=True)
+class Type:
+    """A SQL type and its device lowering."""
+
+    name: str
+    np_dtype: np.dtype  # device representation dtype
+    is_string: bool = False  # dictionary-encoded (codes + host dict)
+
+    def __repr__(self) -> str:
+        return self.name
+
+    # -- classification helpers used by the analyzer/planner ----------------
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("bigint", "integer", "smallint", "tinyint")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("double", "real")
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_floating or self.name.startswith("decimal")
+
+    @property
+    def is_orderable(self) -> bool:
+        return True
+
+    @property
+    def is_comparable(self) -> bool:
+        return True
+
+
+BIGINT = Type("bigint", np.dtype(np.int64))
+INTEGER = Type("integer", np.dtype(np.int32))
+SMALLINT = Type("smallint", np.dtype(np.int16))
+TINYINT = Type("tinyint", np.dtype(np.int8))
+DOUBLE = Type("double", np.dtype(np.float64))
+REAL = Type("real", np.dtype(np.float32))
+BOOLEAN = Type("boolean", np.dtype(np.bool_))
+# DATE is days since 1970-01-01, matching the reference (spi/type/DateType.java).
+DATE = Type("date", np.dtype(np.int32))
+# TIMESTAMP stored as microseconds since epoch (reference supports precisions
+# 0-12, spi/type/TimestampType.java; we implement micros = precision 6).
+TIMESTAMP = Type("timestamp", np.dtype(np.int64))
+# VARCHAR device repr is int32 dictionary codes; -1 is never used (nulls are
+# carried in the validity mask, codes of null rows are 0).
+VARCHAR = Type("varchar", np.dtype(np.int32), is_string=True)
+# Placeholder for NULL literals before the analyzer resolves a concrete type.
+UNKNOWN = Type("unknown", np.dtype(np.int8))
+
+
+@dataclass(frozen=True, repr=False)
+class DecimalType(Type):
+    """DECIMAL(p, s) as a scaled int64 (covers p <= 18; the reference's
+    Int128-backed long decimals, spi/type/Int128Math.java, are future work)."""
+
+    precision: int = 18
+    scale: int = 0
+
+    def __init__(self, precision: int = 18, scale: int = 0):
+        if precision > 18:
+            raise NotImplementedError("decimal precision > 18 not supported yet")
+        object.__setattr__(self, "name", f"decimal({precision},{scale})")
+        object.__setattr__(self, "np_dtype", np.dtype(np.int64))
+        object.__setattr__(self, "is_string", False)
+        object.__setattr__(self, "precision", precision)
+        object.__setattr__(self, "scale", scale)
+
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def date_to_days(value: str | datetime.date) -> int:
+    if isinstance(value, str):
+        value = datetime.date.fromisoformat(value)
+    return (value - _EPOCH).days
+
+
+def days_to_date(days: int) -> datetime.date:
+    return _EPOCH + datetime.timedelta(days=int(days))
+
+
+_BY_NAME = {
+    t.name: t
+    for t in (BIGINT, INTEGER, SMALLINT, TINYINT, DOUBLE, REAL, BOOLEAN, DATE, TIMESTAMP, VARCHAR)
+}
+
+
+def parse_type(text: str) -> Type:
+    """Parse a type name as it appears in SQL (CAST targets, DDL)."""
+    t = text.strip().lower()
+    if t in _BY_NAME:
+        return _BY_NAME[t]
+    if t in ("int",):
+        return INTEGER
+    if t.startswith("varchar"):  # varchar(n): length is not enforced on device
+        return VARCHAR
+    if t.startswith("decimal") or t.startswith("numeric"):
+        inner = t[t.index("(") + 1 : t.index(")")] if "(" in t else "18,0"
+        parts = [p.strip() for p in inner.split(",")]
+        precision = int(parts[0])
+        scale = int(parts[1]) if len(parts) > 1 else 0
+        return DecimalType(precision, scale)
+    raise ValueError(f"unknown type: {text!r}")
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Implicit coercion lattice (reference: spi/type/TypeCoercion via
+    metadata; simplified to the numeric tower + identity)."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    order = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3, "real": 4, "double": 5}
+    if a.name in order and b.name in order:
+        # any integer + any float -> double; otherwise wider integer
+        if a.is_floating or b.is_floating:
+            return DOUBLE
+        return a if order[a.name] >= order[b.name] else b
+    if a.is_numeric and b.is_numeric:  # decimals mix -> double (simplified)
+        return DOUBLE
+    if a.name == "date" and b.name == "varchar":
+        return DATE
+    if b.name == "date" and a.name == "varchar":
+        return DATE
+    raise TypeError(f"no common type for {a} and {b}")
