@@ -1,0 +1,134 @@
+"""Typed expression IR.
+
+The reference keeps a post-analysis IR distinct from the parser AST
+(core/trino-main/.../sql/ir/: Call, Constant, Case, Comparison,
+FieldReference).  Same split here: the planner resolves AST names/types into
+this IR, whose nodes reference input columns *positionally* (FieldRef) so
+kernels never see names.
+
+Every node carries its result Type.  Evaluation semantics (ops/expr.py):
+an IR expression evaluates over a Page to (data: jnp.ndarray, valid: mask).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..data.types import BOOLEAN, Type
+
+__all__ = ["IrExpr", "FieldRef", "Const", "Call", "CaseWhen", "InListIr", "LikeIr", "field_refs"]
+
+
+class IrExpr:
+    __slots__ = ()
+    type: Type
+
+
+@dataclass(frozen=True)
+class FieldRef(IrExpr):
+    """Positional reference into the operator's input page."""
+
+    index: int
+    type: Type
+
+    def __str__(self) -> str:
+        return f"$[{self.index}]"
+
+
+@dataclass(frozen=True)
+class Const(IrExpr):
+    value: object  # python scalar; None == typed NULL; str for VARCHAR consts
+    type: Type
+
+    def __str__(self) -> str:
+        return repr(self.value)
+
+
+@dataclass(frozen=True)
+class Call(IrExpr):
+    """Scalar operation. op is one of:
+    arithmetic: add sub mul div mod neg
+    comparison: eq ne lt le gt ge
+    logical:    and or not
+    null:       is_null coalesce
+    date:       extract_year extract_month date_add
+    string (dictionary-lowered at bind time): substr_eq ... (see ops/expr.py)
+    math:       abs round floor ceil sqrt power
+    """
+
+    op: str
+    args: tuple[IrExpr, ...]
+    type: Type
+
+    def __str__(self) -> str:
+        return f"{self.op}({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class CaseWhen(IrExpr):
+    whens: tuple[tuple[IrExpr, IrExpr], ...]
+    default: Optional[IrExpr]
+    type: Type
+
+
+@dataclass(frozen=True)
+class InListIr(IrExpr):
+    operand: IrExpr
+    values: tuple[object, ...]  # literal python values
+    negated: bool
+    type: Type = BOOLEAN
+
+
+@dataclass(frozen=True)
+class LikeIr(IrExpr):
+    """LIKE over a dictionary-encoded column; evaluated per-dictionary-value
+    on host at bind time (the reference's DictionaryAwarePageProjection fast
+    path made the only path)."""
+
+    operand: IrExpr
+    pattern: str
+    negated: bool
+    type: Type = BOOLEAN
+
+
+def field_refs(e: IrExpr) -> set[int]:
+    """All input column indices an expression reads."""
+    out: set[int] = set()
+    _collect(e, out)
+    return out
+
+
+def _collect(e: IrExpr, out: set[int]) -> None:
+    if isinstance(e, FieldRef):
+        out.add(e.index)
+    elif isinstance(e, Call):
+        for a in e.args:
+            _collect(a, out)
+    elif isinstance(e, CaseWhen):
+        for c, r in e.whens:
+            _collect(c, out)
+            _collect(r, out)
+        if e.default is not None:
+            _collect(e.default, out)
+    elif isinstance(e, (InListIr, LikeIr)):
+        _collect(e.operand, out)
+
+
+def remap(e: IrExpr, mapping: dict[int, int]) -> IrExpr:
+    """Rewrite FieldRef indices (used when pruning/reordering child outputs)."""
+    if isinstance(e, FieldRef):
+        return FieldRef(mapping[e.index], e.type)
+    if isinstance(e, Call):
+        return Call(e.op, tuple(remap(a, mapping) for a in e.args), e.type)
+    if isinstance(e, CaseWhen):
+        return CaseWhen(
+            tuple((remap(c, mapping), remap(r, mapping)) for c, r in e.whens),
+            None if e.default is None else remap(e.default, mapping),
+            e.type,
+        )
+    if isinstance(e, InListIr):
+        return InListIr(remap(e.operand, mapping), e.values, e.negated, e.type)
+    if isinstance(e, LikeIr):
+        return LikeIr(remap(e.operand, mapping), e.pattern, e.negated, e.type)
+    return e

@@ -1,0 +1,302 @@
+"""Logical/physical plan nodes.
+
+The reference's PlanNode hierarchy lives in sql/planner/plan/ (TableScanNode,
+FilterNode, ProjectNode, AggregationNode, JoinNode, TopNNode, ...).  This
+build keeps one tree used both logically and physically; the executor
+interprets it by compiling each node to a jax stage (the reference's
+LocalExecutionPlanner.java:408 visitor is exec/compiler.py).
+
+Every node exposes `output_types` and `output_names` — the page schema it
+produces.  Expression trees inside nodes are typed IR (plan/ir.py) with
+FieldRefs positional into the node's child output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..data.types import Type
+from .ir import IrExpr
+
+__all__ = [
+    "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
+    "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
+]
+
+
+class PlanNode:
+    __slots__ = ()
+    output_names: tuple[str, ...]
+    output_types: tuple[Type, ...]
+
+    @property
+    def children(self) -> tuple["PlanNode", ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class TableScan(PlanNode):
+    """Scan of a connector table (reference: TableScanNode + connector split
+    machinery).  `column_indices` selects/orders columns of the connector
+    schema (projection pushdown into the scan)."""
+
+    catalog: str
+    table: str
+    column_names: tuple[str, ...]
+    output_types: tuple[Type, ...]
+
+    @property
+    def output_names(self) -> tuple[str, ...]:
+        return self.column_names
+
+
+@dataclass(frozen=True)
+class Filter(PlanNode):
+    child: PlanNode
+    predicate: IrExpr  # boolean IR over child's output
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class Project(PlanNode):
+    child: PlanNode
+    expressions: tuple[IrExpr, ...]
+    names: tuple[str, ...]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.names
+
+    @property
+    def output_types(self):
+        return tuple(e.type for e in self.expressions)
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """One aggregate: fn in {sum, count, min, max, avg, count_star};
+    arg is None only for count_star. distinct per-agg (count(distinct x))."""
+
+    fn: str
+    arg: Optional[IrExpr]
+    type: Type
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Aggregate(PlanNode):
+    """Group-by aggregation (reference: AggregationNode; executed by
+    HashAggregationOperator/FlatHash — here a sort-based device kernel).
+    step: 'single' | 'partial' | 'final' (partial/final split inserted by the
+    distributed planner around exchanges, AddExchanges.java visitAggregation)."""
+
+    child: PlanNode
+    group_keys: tuple[IrExpr, ...]
+    aggs: tuple[AggCall, ...]
+    names: tuple[str, ...]  # group names then agg names
+    step: str = "single"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.names
+
+    @property
+    def output_types(self):
+        return tuple(k.type for k in self.group_keys) + tuple(a.type for a in self.aggs)
+
+
+@dataclass(frozen=True)
+class Join(PlanNode):
+    """Equi-join with optional residual filter.
+
+    kind: inner | left | semi | anti | cross.
+    (right/full are normalized to left by swapping inputs at plan time.)
+    left_keys/right_keys: IR over the respective child outputs.
+    residual: boolean IR over the *concatenated* (left ++ right) schema —
+    for semi/anti it may also reference right columns (correlated EXISTS
+    extra predicates); output for semi/anti is the left schema only.
+    distribution: 'partitioned' | 'broadcast' (reference:
+    DetermineJoinDistributionType.java:51) — used by the distributed planner.
+    """
+
+    kind: str
+    left: PlanNode
+    right: PlanNode
+    left_keys: tuple[IrExpr, ...]
+    right_keys: tuple[IrExpr, ...]
+    residual: Optional[IrExpr] = None
+    distribution: str = "broadcast"
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def output_names(self):
+        if self.kind in ("semi", "anti"):
+            return self.left.output_names
+        return self.left.output_names + self.right.output_names
+
+    @property
+    def output_types(self):
+        if self.kind in ("semi", "anti"):
+            return self.left.output_types
+        return self.left.output_types + self.right.output_types
+
+
+@dataclass(frozen=True)
+class SortKey:
+    expr: IrExpr
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+@dataclass(frozen=True)
+class Sort(PlanNode):
+    child: PlanNode
+    keys: tuple[SortKey, ...]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class TopN(PlanNode):
+    """Sort + limit fused (reference: TopNOperator.java:32)."""
+
+    child: PlanNode
+    keys: tuple[SortKey, ...]
+    count: int
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class Limit(PlanNode):
+    child: PlanNode
+    count: int
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class Distinct(PlanNode):
+    """SELECT DISTINCT (reference: AggregationNode with no aggregates /
+    MarkDistinct family)."""
+
+    child: PlanNode
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class Values(PlanNode):
+    """Literal rows (reference: ValuesNode)."""
+
+    names: tuple[str, ...]
+    types: tuple[Type, ...]
+    rows: tuple[tuple[object, ...], ...]
+
+    @property
+    def output_names(self):
+        return self.names
+
+    @property
+    def output_types(self):
+        return self.types
+
+
+def walk(node: PlanNode):
+    yield node
+    for c in node.children:
+        yield from walk(c)
+
+
+def format_plan(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style plan rendering."""
+    pad = "  " * indent
+    label = type(node).__name__
+    detail = ""
+    if isinstance(node, TableScan):
+        detail = f" {node.catalog}.{node.table} {list(node.column_names)}"
+    elif isinstance(node, Filter):
+        detail = f" [{node.predicate}]"
+    elif isinstance(node, Project):
+        detail = f" {[f'{n}={e}' for n, e in zip(node.names, node.expressions)]}"
+    elif isinstance(node, Aggregate):
+        detail = f" step={node.step} keys={[str(k) for k in node.group_keys]} aggs={[f'{a.fn}({a.arg})' for a in node.aggs]}"
+    elif isinstance(node, Join):
+        detail = (
+            f" {node.kind} {node.distribution} on "
+            f"{[f'{l}={r}' for l, r in zip(node.left_keys, node.right_keys)]}"
+            + (f" residual=[{node.residual}]" if node.residual is not None else "")
+        )
+    elif isinstance(node, (Sort, TopN)):
+        detail = f" keys={[(str(k.expr), 'asc' if k.ascending else 'desc') for k in node.keys]}"
+        if isinstance(node, TopN):
+            detail += f" count={node.count}"
+    elif isinstance(node, Limit):
+        detail = f" count={node.count}"
+    lines = [f"{pad}{label}{detail}"]
+    for c in node.children:
+        lines.append(format_plan(c, indent + 1))
+    return "\n".join(lines)

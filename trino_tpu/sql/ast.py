@@ -1,0 +1,223 @@
+"""SQL abstract syntax tree.
+
+The reference builds ~200 AST node classes in core/trino-parser/ from the
+ANTLR parse tree.  This is the analytic subset the engine supports, kept
+deliberately flat: plain dataclasses, no visitor machinery (Python pattern
+matching covers it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+# ---------------------------------------------------------------- expressions
+
+
+class Expr:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Ident(Expr):
+    """Possibly-qualified column reference: name or alias.name."""
+
+    parts: tuple[str, ...]
+
+    def __str__(self) -> str:
+        return ".".join(self.parts)
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    qualifier: Optional[str] = None  # t.* vs *
+
+
+@dataclass(frozen=True)
+class IntLit(Expr):
+    value: int
+
+
+@dataclass(frozen=True)
+class FloatLit(Expr):
+    value: float
+
+
+@dataclass(frozen=True)
+class StrLit(Expr):
+    value: str
+
+
+@dataclass(frozen=True)
+class BoolLit(Expr):
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLit(Expr):
+    pass
+
+
+@dataclass(frozen=True)
+class DateLit(Expr):
+    value: str  # ISO yyyy-mm-dd
+
+
+@dataclass(frozen=True)
+class IntervalLit(Expr):
+    value: int
+    unit: str  # day | month | year
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class Neg(Expr):
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str  # lowercase
+    args: tuple[Expr, ...]
+    distinct: bool = False  # count(distinct x)
+
+
+@dataclass(frozen=True)
+class CaseExpr(Expr):
+    whens: tuple[tuple[Expr, Expr], ...]  # (condition, result)
+    default: Optional[Expr]  # ELSE
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    operand: Expr
+    type_name: str
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    operand: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    operand: Expr
+    pattern: Expr  # must be a string literal for device eval
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Extract(Expr):
+    field: str  # year | month | day
+    operand: Expr
+
+
+# subqueries -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    operand: Expr
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Expr):
+    query: "Query"
+    negated: bool = False
+
+
+# ----------------------------------------------------------------- relations
+
+
+class Relation:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Table(Relation):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRelation(Relation):
+    query: "Query"
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class JoinRelation(Relation):
+    kind: str  # inner | left | right | full | cross
+    left: Relation
+    right: Relation
+    on: Optional[Expr] = None
+
+
+# --------------------------------------------------------------------- query
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SortItem:
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None == dialect default (last for asc)
+
+
+@dataclass(frozen=True)
+class Select:
+    items: tuple[SelectItem | Star, ...]
+    relations: tuple[Relation, ...]  # comma-separated FROM list (implicit cross join)
+    where: Optional[Expr] = None
+    group_by: tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Query:
+    """A full query expression: SELECT body + ORDER BY/LIMIT + optional WITH."""
+
+    select: Select
+    order_by: tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    ctes: tuple[tuple[str, "Query"], ...] = field(default=())

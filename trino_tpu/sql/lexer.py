@@ -1,0 +1,116 @@
+"""SQL lexer.
+
+The reference uses an ANTLR4 grammar (core/trino-grammar/.../SqlBase.g4, 1471
+lines).  This build uses a hand-written lexer + recursive-descent parser for
+the analytic SQL subset the engine executes; the token model mirrors the
+grammar's lexical rules (identifiers, quoted identifiers, string literals,
+numbers, operators, comments).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+__all__ = ["Token", "tokenize", "SqlSyntaxError"]
+
+
+class SqlSyntaxError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # IDENT | QIDENT | STRING | NUMBER | OP | EOF
+    value: str
+    pos: int
+
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+_OPERATORS = [
+    "<>", "!=", ">=", "<=", "||", "->",
+    "+", "-", "*", "/", "%", "(", ")", ",", ".", ";", "<", ">", "=", "?",
+]
+
+
+def tokenize(sql: str) -> list[Token]:
+    return list(_tokens(sql))
+
+
+def _tokens(sql: str) -> Iterator[Token]:
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i)
+            if j < 0:
+                raise SqlSyntaxError(f"unterminated block comment at {i}")
+            i = j + 2
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    raise SqlSyntaxError(f"unterminated string literal at {i}")
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":  # escaped ''
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            yield Token("STRING", "".join(buf), i)
+            i = j + 1
+            continue
+        if c == '"':
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise SqlSyntaxError(f"unterminated quoted identifier at {i}")
+            yield Token("QIDENT", sql[i + 1 : j], i)
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_exp and j + 1 < n and (sql[j + 1].isdigit() or sql[j + 1] in "+-"):
+                    seen_exp = True
+                    j += 2 if sql[j + 1] in "+-" else 1
+                else:
+                    break
+            yield Token("NUMBER", sql[i:j], i)
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            yield Token("IDENT", sql[i:j], i)
+            i = j
+            continue
+        for op in _OPERATORS:
+            if sql.startswith(op, i):
+                yield Token("OP", op, i)
+                i += len(op)
+                break
+        else:
+            raise SqlSyntaxError(f"unexpected character {c!r} at position {i}")
+    yield Token("EOF", "", n)

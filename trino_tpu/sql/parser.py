@@ -1,0 +1,484 @@
+"""Recursive-descent SQL parser.
+
+Replaces the reference's ANTLR grammar + AST builder
+(core/trino-grammar/.../SqlBase.g4, core/trino-parser/.../SqlParser).
+Covers the analytic subset: SELECT [DISTINCT] ... FROM (tables, subqueries,
+JOIN ... ON) WHERE / GROUP BY / HAVING / ORDER BY / LIMIT, WITH ctes,
+scalar/IN/EXISTS subqueries, CASE, CAST, EXTRACT, SUBSTRING, LIKE, BETWEEN,
+IN lists, IS [NOT] NULL, date/interval literals.
+
+Expression precedence (lowest first): OR, AND, NOT, comparison/IN/BETWEEN/
+LIKE/IS, additive, multiplicative, unary minus, postfix (none), primary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ast import (
+    Between, BinOp, BoolLit, CaseExpr, Cast, DateLit, Exists, Expr, Extract,
+    FloatLit, FuncCall, Ident, InList, InSubquery, IntLit, IntervalLit, IsNull,
+    JoinRelation, Like, Neg, Not, NullLit, Query, Relation, ScalarSubquery,
+    Select, SelectItem, SortItem, Star, StrLit, SubqueryRelation, Table,
+)
+from .lexer import SqlSyntaxError, Token, tokenize
+
+__all__ = ["parse", "SqlSyntaxError"]
+
+_RESERVED_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "ON", "JOIN",
+    "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "UNION", "EXCEPT", "INTERSECT",
+    "AND", "OR", "NOT", "AS", "BY", "ASC", "DESC", "THEN", "ELSE", "WHEN",
+    "END", "SELECT", "WITH", "USING", "NULLS",
+}
+
+
+def parse(sql: str) -> Query:
+    p = _Parser(tokenize(sql))
+    q = p.parse_query()
+    p.accept_op(";")
+    p.expect_eof()
+    return q
+
+
+class _Parser:
+    def __init__(self, tokens: list[Token]):
+        self.tokens = tokens
+        self.i = 0
+
+    # ------------------------------------------------------------- utilities
+    @property
+    def cur(self) -> Token:
+        return self.tokens[self.i]
+
+    def peek_kw(self, *kws: str, offset: int = 0) -> bool:
+        t = self.tokens[min(self.i + offset, len(self.tokens) - 1)]
+        return t.kind == "IDENT" and t.upper() in kws
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        if self.peek_kw(*kws):
+            kw = self.cur.upper()
+            self.i += 1
+            return kw
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SqlSyntaxError(f"expected {kw} at {self.cur.pos}, got {self.cur.value!r}")
+
+    def peek_op(self, *ops: str) -> bool:
+        return self.cur.kind == "OP" and self.cur.value in ops
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.peek_op(*ops):
+            v = self.cur.value
+            self.i += 1
+            return v
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SqlSyntaxError(f"expected {op!r} at {self.cur.pos}, got {self.cur.value!r}")
+
+    def expect_eof(self) -> None:
+        if self.cur.kind != "EOF":
+            raise SqlSyntaxError(f"unexpected trailing input at {self.cur.pos}: {self.cur.value!r}")
+
+    def ident(self) -> str:
+        t = self.cur
+        if t.kind == "QIDENT":
+            self.i += 1
+            return t.value
+        if t.kind == "IDENT":
+            self.i += 1
+            return t.value.lower()
+        raise SqlSyntaxError(f"expected identifier at {t.pos}, got {t.value!r}")
+
+    # ----------------------------------------------------------------- query
+    def parse_query(self) -> Query:
+        ctes: list[tuple[str, Query]] = []
+        if self.accept_kw("WITH"):
+            while True:
+                name = self.ident()
+                self.expect_kw("AS")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                ctes.append((name, q))
+                if not self.accept_op(","):
+                    break
+        select = self.parse_select()
+        order_by: list[SortItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("DESC"):
+                    asc = False
+                else:
+                    self.accept_kw("ASC")
+                nulls_first = None
+                if self.accept_kw("NULLS"):
+                    nulls_first = bool(self.accept_kw("FIRST"))
+                    if not nulls_first:
+                        self.expect_kw("LAST")
+                order_by.append(SortItem(e, asc, nulls_first))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        if self.accept_kw("LIMIT"):
+            t = self.cur
+            if t.kind != "NUMBER":
+                raise SqlSyntaxError(f"expected LIMIT count at {t.pos}")
+            limit = int(t.value)
+            self.i += 1
+        return Query(select, tuple(order_by), limit, tuple(ctes))
+
+    def parse_select(self) -> Select:
+        self.expect_kw("SELECT")
+        distinct = bool(self.accept_kw("DISTINCT"))
+        self.accept_kw("ALL")
+        items: list[SelectItem | Star] = []
+        while True:
+            if self.peek_op("*"):
+                self.i += 1
+                items.append(Star())
+            elif (
+                self.cur.kind in ("IDENT", "QIDENT")
+                and self.tokens[self.i + 1].kind == "OP"
+                and self.tokens[self.i + 1].value == "."
+                and self.tokens[self.i + 2].kind == "OP"
+                and self.tokens[self.i + 2].value == "*"
+            ):
+                q = self.ident()
+                self.i += 2
+                items.append(Star(q))
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.accept_kw("AS"):
+                    alias = self.ident()
+                elif self.cur.kind in ("IDENT", "QIDENT") and not self._is_reserved():
+                    alias = self.ident()
+                items.append(SelectItem(e, alias))
+            if not self.accept_op(","):
+                break
+        relations: list[Relation] = []
+        if self.accept_kw("FROM"):
+            while True:
+                relations.append(self.parse_join_chain())
+                if not self.accept_op(","):
+                    break
+        where = None
+        if self.accept_kw("WHERE"):
+            where = self.parse_expr()
+        group_by: list[Expr] = []
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            while True:
+                group_by.append(self.parse_expr())
+                if not self.accept_op(","):
+                    break
+        having = None
+        if self.accept_kw("HAVING"):
+            having = self.parse_expr()
+        return Select(tuple(items), tuple(relations), where, tuple(group_by), having, distinct)
+
+    def _is_reserved(self) -> bool:
+        return self.cur.kind == "IDENT" and self.cur.upper() in _RESERVED_STOP
+
+    # ------------------------------------------------------------- relations
+    def parse_join_chain(self) -> Relation:
+        rel = self.parse_relation_primary()
+        while True:
+            kind = None
+            if self.accept_kw("CROSS"):
+                self.expect_kw("JOIN")
+                right = self.parse_relation_primary()
+                rel = JoinRelation("cross", rel, right, None)
+                continue
+            if self.accept_kw("INNER"):
+                kind = "inner"
+                self.expect_kw("JOIN")
+            elif self.accept_kw("LEFT"):
+                self.accept_kw("OUTER")
+                kind = "left"
+                self.expect_kw("JOIN")
+            elif self.accept_kw("RIGHT"):
+                self.accept_kw("OUTER")
+                kind = "right"
+                self.expect_kw("JOIN")
+            elif self.accept_kw("FULL"):
+                self.accept_kw("OUTER")
+                kind = "full"
+                self.expect_kw("JOIN")
+            elif self.accept_kw("JOIN"):
+                kind = "inner"
+            else:
+                return rel
+            right = self.parse_relation_primary()
+            self.expect_kw("ON")
+            on = self.parse_expr()
+            rel = JoinRelation(kind, rel, right, on)
+
+    def parse_relation_primary(self) -> Relation:
+        if self.accept_op("("):
+            if self.peek_kw("SELECT", "WITH"):
+                q = self.parse_query()
+                self.expect_op(")")
+                alias = self._optional_alias()
+                return SubqueryRelation(q, alias)
+            rel = self.parse_join_chain()
+            self.expect_op(")")
+            return rel
+        name = self.ident()
+        # swallow catalog.schema qualifiers: keep the last part as table name
+        while self.accept_op("."):
+            name = self.ident()
+        alias = self._optional_alias()
+        return Table(name, alias)
+
+    def _optional_alias(self) -> Optional[str]:
+        if self.accept_kw("AS"):
+            return self.ident()
+        if self.cur.kind in ("IDENT", "QIDENT") and not self._is_reserved():
+            return self.ident()
+        return None
+
+    # ----------------------------------------------------------- expressions
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        left = self.parse_and()
+        while self.accept_kw("OR"):
+            left = BinOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Expr:
+        left = self.parse_not()
+        while self.accept_kw("AND"):
+            left = BinOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Expr:
+        if self.accept_kw("NOT"):
+            return Not(self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expr:
+        left = self.parse_additive()
+        while True:
+            negated = False
+            save = self.i
+            if self.accept_kw("NOT"):
+                negated = True
+            if self.accept_kw("BETWEEN"):
+                low = self.parse_additive()
+                self.expect_kw("AND")
+                high = self.parse_additive()
+                left = Between(left, low, high, negated)
+                continue
+            if self.accept_kw("IN"):
+                self.expect_op("(")
+                if self.peek_kw("SELECT", "WITH"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = InSubquery(left, q, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = InList(left, tuple(items), negated)
+                continue
+            if self.accept_kw("LIKE"):
+                pattern = self.parse_additive()
+                left = Like(left, pattern, negated)
+                continue
+            if negated:
+                self.i = save  # NOT belonged to an outer parse_not
+                return left
+            if self.accept_kw("IS"):
+                neg = bool(self.accept_kw("NOT"))
+                self.expect_kw("NULL")
+                left = IsNull(left, neg)
+                continue
+            op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+            if op is None:
+                return left
+            if op == "!=":
+                op = "<>"
+            left = BinOp(op, left, self.parse_additive())
+
+    def parse_additive(self) -> Expr:
+        left = self.parse_multiplicative()
+        while True:
+            op = self.accept_op("+", "-")
+            if op is None:
+                return left
+            right = self.parse_multiplicative()
+            if isinstance(right, IntervalLit):
+                # date +/- interval lowered to a date_add call
+                left = FuncCall("date_add", (left, IntLit(right.value if op == "+" else -right.value), StrLit(right.unit)))
+            else:
+                left = BinOp(op, left, right)
+
+    def parse_multiplicative(self) -> Expr:
+        left = self.parse_unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if op is None:
+                return left
+            left = BinOp(op, left, self.parse_unary())
+
+    def parse_unary(self) -> Expr:
+        if self.accept_op("-"):
+            return Neg(self.parse_unary())
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.cur
+        if t.kind == "NUMBER":
+            self.i += 1
+            if "." in t.value or "e" in t.value or "E" in t.value:
+                return FloatLit(float(t.value))
+            return IntLit(int(t.value))
+        if t.kind == "STRING":
+            self.i += 1
+            return StrLit(t.value)
+        if self.accept_op("("):
+            if self.peek_kw("SELECT", "WITH"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind in ("IDENT", "QIDENT"):
+            kw = t.upper() if t.kind == "IDENT" else None
+            if kw == "TRUE":
+                self.i += 1
+                return BoolLit(True)
+            if kw == "FALSE":
+                self.i += 1
+                return BoolLit(False)
+            if kw == "NULL":
+                self.i += 1
+                return NullLit()
+            if kw == "DATE" and self.tokens[self.i + 1].kind == "STRING":
+                self.i += 1
+                v = self.cur.value
+                self.i += 1
+                return DateLit(v)
+            if kw == "TIMESTAMP" and self.tokens[self.i + 1].kind == "STRING":
+                self.i += 1
+                v = self.cur.value
+                self.i += 1
+                return DateLit(v[:10])  # date part; micros handled at ingest
+            if kw == "INTERVAL":
+                self.i += 1
+                v = self.cur
+                if v.kind != "STRING":
+                    raise SqlSyntaxError(f"expected interval literal at {v.pos}")
+                self.i += 1
+                unit = self.ident().lower()
+                unit = unit.rstrip("s") if unit.endswith("s") else unit
+                return IntervalLit(int(v.value), unit)
+            if kw == "CASE":
+                return self.parse_case()
+            if kw == "CAST":
+                self.i += 1
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("AS")
+                type_name = self.parse_type_name()
+                self.expect_op(")")
+                return Cast(e, type_name)
+            if kw == "EXISTS":
+                self.i += 1
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                return Exists(q)
+            if kw == "EXTRACT":
+                self.i += 1
+                self.expect_op("(")
+                fieldname = self.ident().lower()
+                self.expect_kw("FROM")
+                e = self.parse_expr()
+                self.expect_op(")")
+                return Extract(fieldname, e)
+            if kw == "SUBSTRING":
+                self.i += 1
+                self.expect_op("(")
+                e = self.parse_expr()
+                if self.accept_kw("FROM"):
+                    start = self.parse_expr()
+                    length = None
+                    if self.accept_kw("FOR"):
+                        length = self.parse_expr()
+                else:
+                    self.expect_op(",")
+                    start = self.parse_expr()
+                    length = None
+                    if self.accept_op(","):
+                        length = self.parse_expr()
+                self.expect_op(")")
+                args = (e, start) if length is None else (e, start, length)
+                return FuncCall("substring", args)
+            # function call or column reference
+            if self.tokens[self.i + 1].kind == "OP" and self.tokens[self.i + 1].value == "(":
+                name = self.ident().lower()
+                self.expect_op("(")
+                if name == "count" and self.peek_op("*"):
+                    self.i += 1
+                    self.expect_op(")")
+                    return FuncCall("count", ())
+                distinct = bool(self.accept_kw("DISTINCT"))
+                args: list[Expr] = []
+                if not self.peek_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return FuncCall(name, tuple(args), distinct)
+            parts = [self.ident()]
+            while self.accept_op("."):
+                parts.append(self.ident())
+            return Ident(tuple(parts))
+        raise SqlSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_case(self) -> Expr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.peek_kw("WHEN"):
+            operand = self.parse_expr()
+        whens: list[tuple[Expr, Expr]] = []
+        while self.accept_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            result = self.parse_expr()
+            if operand is not None:
+                cond = BinOp("=", operand, cond)
+            whens.append((cond, result))
+        default = None
+        if self.accept_kw("ELSE"):
+            default = self.parse_expr()
+        self.expect_kw("END")
+        return CaseExpr(tuple(whens), default)
+
+    def parse_type_name(self) -> str:
+        name = self.ident()
+        if self.accept_op("("):
+            params = [self.cur.value]
+            self.i += 1
+            while self.accept_op(","):
+                params.append(self.cur.value)
+                self.i += 1
+            self.expect_op(")")
+            name = f"{name}({','.join(params)})"
+        return name
