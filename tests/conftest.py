@@ -11,10 +11,20 @@ Env vars MUST be set before jax initializes its backends, hence here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the environment's sitecustomize pins JAX_PLATFORMS=axon (one
+# real TPU chip), but correctness tests need (a) true float64 — TPU silently
+# computes f64 at f32 precision — and (b) 8 virtual devices for the
+# multi-chip exchange tests.  Hence a hard override, not setdefault.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize may have imported jax already (axon boot); the config update
+# still wins as long as no backend has been used yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,29 @@
+"""Differential TPC-H suite: engine vs sqlite oracle over identical data.
+
+The reference's AbstractTestQueryFramework.assertQuery pattern
+(testing/trino-testing/.../AbstractTestQueryFramework.java:344): run each
+query on both engines, diff rows with float tolerance.
+"""
+
+import pytest
+
+from tests.oracle import assert_rows_equal
+from tests.tpch_queries import ORDERED, QUERIES
+
+
+@pytest.fixture(scope="module")
+def engine(tpch_tiny):
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine()
+    eng.register_catalog("tpch", TpchConnector(0.01))
+    return eng
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_tpch_query(name, engine, oracle):
+    sql = QUERIES[name]
+    got = engine.query(sql)
+    expected = oracle.query(sql)
+    assert_rows_equal(got, expected, ordered=ORDERED[name])

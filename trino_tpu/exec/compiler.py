@@ -1,0 +1,331 @@
+"""Plan compiler + local executor.
+
+The reference's LocalExecutionPlanner (sql/planner/LocalExecutionPlanner.java
+:408) visits the plan and wires OperatorFactory chains that pull pages
+through virtual calls (operator/Driver.java:372).  Here the visitor *traces*
+the whole plan into ONE jax.jit program: every operator contributes
+vectorized ops over (columns, live-mask) pairs and XLA fuses the chain —
+per-page virtual dispatch becomes a single compiled kernel per fragment.
+
+Capacity protocol (the static-shape answer to dynamic selectivity/fan-out,
+replacing the reference's growable hash tables and blocking memory futures):
+stateful nodes (join expansion, group-by) get a static capacity from
+`CapacityPlan`; the traced program returns the true required size for every
+such node; the host retries at the next power-of-two tier on overflow and
+caches the compiled program per (plan, capacities).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..connectors.spi import CatalogManager
+from ..data.page import Column, Page
+from ..data.types import Type
+from ..ops.expr import ColumnVal, column_val, eval_expr, eval_predicate
+from ..ops.relops import (
+    AggSpec, SortSpec, broadcast_single_row, equi_join, group_aggregate,
+    limit_mask, sort_rows, top_n,
+)
+from ..plan.nodes import (
+    Aggregate, Distinct, Filter, Join, Limit, PlanNode, Project, Sort,
+    TableScan, TopN, Values,
+)
+
+__all__ = ["LocalExecutor"]
+
+
+@dataclass
+class _Stage:
+    cols: list[ColumnVal]
+    live: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return int(self.live.shape[0])
+
+
+def _node_ids(plan: PlanNode) -> dict[int, PlanNode]:
+    """Stable preorder numbering (plan trees are immutable)."""
+    out: dict[int, PlanNode] = {}
+
+    def visit(n: PlanNode):
+        out[len(out)] = n
+        for c in n.children:
+            visit(c)
+
+    visit(plan)
+    return out
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class LocalExecutor:
+    """Single-process execution over device-resident table pages (the
+    reference's PlanTester.executeStatement analogue, testing/PlanTester.java
+    :706 — full engine, no HTTP)."""
+
+    def __init__(self, catalogs: CatalogManager, default_catalog: str = "tpch"):
+        self.catalogs = catalogs
+        self.default_catalog = default_catalog
+        self._table_pages: dict[tuple[str, str], Page] = {}
+        self._jit_cache: dict = {}
+
+    # ------------------------------------------------------------- table IO
+    def table_page(self, catalog: str, table: str, columns: Sequence[str], types) -> Page:
+        key = (catalog, table)
+        if key not in self._table_pages:
+            conn = self.catalogs.get(catalog)
+            schema = conn.table_schema(table)
+            splits = conn.get_splits(table, 1)
+            all_cols = schema.column_names()
+            data = conn.read_split(splits[0], all_cols)
+            for s in splits[1:]:
+                more = conn.read_split(s, all_cols)
+                data = {c: np.concatenate([data[c], more[c]]) for c in all_cols}
+            page = Page.from_numpy(
+                [schema.type_of(c) for c in all_cols], [data[c] for c in all_cols]
+            )
+            self._table_pages[key] = page
+        page = self._table_pages[key]
+        conn = self.catalogs.get(catalog)
+        schema = conn.table_schema(table)
+        idx = [schema.column_index(c) for c in columns]
+        return page.select_columns(idx)
+
+    # ------------------------------------------------------------ execution
+    def execute(self, plan: PlanNode) -> Page:
+        nodes = _node_ids(plan)
+        scans = {i: n for i, n in nodes.items() if isinstance(n, TableScan)}
+        inputs = {
+            str(i): self.table_page(n.catalog, n.table, n.column_names, n.output_types)
+            for i, n in scans.items()
+        }
+        caps = self._initial_caps(nodes, inputs)
+        for _ in range(12):  # capacity-retry loop
+            out_page, required = self._run(plan, inputs, caps)
+            overflow = {
+                nid: int(req) for nid, req in required.items() if int(req) > caps[nid]
+            }
+            if not overflow:
+                return out_page
+            for nid, req in overflow.items():
+                caps[nid] = _pow2(max(req, caps[nid] * 2))
+        raise RuntimeError(f"capacity retry loop did not converge: {caps}")
+
+    def execute_to_rows(self, plan: PlanNode) -> list[tuple]:
+        return self.execute(plan).to_pylist()
+
+    def _initial_caps(self, nodes, inputs) -> dict[int, int]:
+        # conservative first guesses; the retry loop corrects upward
+        caps: dict[int, int] = {}
+        sizes: dict[int, int] = {}
+
+        def size_of(nid: int, n: PlanNode) -> int:
+            if isinstance(n, TableScan):
+                return inputs[str(nid)].capacity
+            child_ids = _child_ids(nodes, nid)
+            child_sizes = [size_of(c, nodes[c]) for c in child_ids]
+            if isinstance(n, Aggregate):
+                caps[nid] = _pow2(max(child_sizes[0], 1))
+                return caps[nid]
+            if isinstance(n, Distinct):
+                caps[nid] = _pow2(max(child_sizes[0], 1))
+                return caps[nid]
+            if isinstance(n, Join):
+                if n.kind in ("semi", "anti"):
+                    caps[nid] = _pow2(max(max(child_sizes), 1))
+                    return child_sizes[0]
+                if n.kind == "cross":
+                    return child_sizes[0]
+                caps[nid] = _pow2(max(max(child_sizes), 1))
+                if n.kind == "left":
+                    return caps[nid] + child_sizes[0]
+                return caps[nid]
+            if isinstance(n, TopN):
+                return min(n.count, child_sizes[0])
+            return child_sizes[0]
+
+        size_of(0, nodes[0])
+        return caps
+
+    def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
+        cache_key = (plan, tuple(sorted(caps.items())),
+                     tuple(sorted((k, p.capacity) for k, p in inputs.items())))
+        if cache_key not in self._jit_cache:
+            self._jit_cache[cache_key] = jax.jit(
+                lambda pages: _trace_plan(plan, pages, caps)
+            )
+        out_page, required = self._jit_cache[cache_key](inputs)
+        return out_page, {k: int(v) for k, v in required.items()}
+
+
+def _child_ids(nodes: dict[int, PlanNode], nid: int) -> list[int]:
+    n = nodes[nid]
+    ids = []
+    next_id = nid + 1
+    for c in n.children:
+        ids.append(next_id)
+        next_id += len(_node_ids(c))
+    return ids
+
+
+def _trace_plan(plan: PlanNode, pages: dict[str, Page], caps: dict[int, int]):
+    required: dict[int, jnp.ndarray] = {}
+    counter = [0]
+
+    def emit(node: PlanNode) -> _Stage:
+        nid = counter[0]
+        counter[0] += 1
+
+        if isinstance(node, TableScan):
+            page = pages[str(nid)]
+            cols = [column_val(c) for c in page.columns]
+            for cv, t in zip(cols, node.output_types):
+                cv.type = t
+            return _Stage(cols, page.live_mask())
+
+        if isinstance(node, Filter):
+            s = emit(node.child)
+            mask = eval_predicate(node.predicate, s.cols, s.capacity)
+            return _Stage(s.cols, s.live & mask)
+
+        if isinstance(node, Project):
+            s = emit(node.child)
+            cols = [eval_expr(e, s.cols, s.capacity) for e in node.expressions]
+            return _Stage(cols, s.live)
+
+        if isinstance(node, Aggregate):
+            s = emit(node.child)
+            G = caps[nid]
+            keys = [eval_expr(k, s.cols, s.capacity) for k in node.group_keys]
+            args = [
+                None if a.arg is None else eval_expr(a.arg, s.cols, s.capacity)
+                for a in node.aggs
+            ]
+            specs = [AggSpec(a.fn, a.distinct) for a in node.aggs]
+            out_keys, out_aggs, out_live, n_groups = group_aggregate(
+                keys, args, specs, s.live, G
+            )
+            required[nid] = n_groups
+            cols: list[ColumnVal] = []
+            for (data, valid), kv in zip(out_keys, keys):
+                cols.append(ColumnVal(data, _none_if_all(valid), kv.dict, kv.type))
+            for (data, valid), a, arg in zip(out_aggs, node.aggs, args):
+                d = arg.dict if (arg is not None and a.fn in ("min", "max")) else None
+                cols.append(ColumnVal(data, valid, d, a.type))
+            return _Stage(cols, out_live)
+
+        if isinstance(node, Distinct):
+            s = emit(node.child)
+            G = caps[nid]
+            out_keys, _, out_live, n_groups = group_aggregate(
+                s.cols, [], [], s.live, G
+            )
+            required[nid] = n_groups
+            cols = [
+                ColumnVal(data, _none_if_all(valid), cv.dict, cv.type)
+                for (data, valid), cv in zip(out_keys, s.cols)
+            ]
+            return _Stage(cols, out_live)
+
+        if isinstance(node, Join):
+            left = emit(node.left)
+            right = emit(node.right)
+            if node.kind == "cross":
+                cols, live = broadcast_single_row(
+                    left.cols, left.live, right.cols, right.live
+                )
+                return _Stage(cols, live)
+            C = caps[nid]
+            lkeys = [eval_expr(k, left.cols, left.capacity) for k in node.left_keys]
+            rkeys = [eval_expr(k, right.cols, right.capacity) for k in node.right_keys]
+            lkeys, rkeys = _align_join_keys(lkeys, rkeys)
+            residual = None
+            if node.residual is not None:
+                res_ir = node.residual
+
+                def residual(gathered, cap, _ir=res_ir):
+                    return eval_predicate(_ir, gathered, cap)
+
+            cols, live, req = equi_join(
+                node.kind, left.cols, left.live, right.cols, right.live,
+                lkeys, rkeys, residual, C,
+            )
+            required[nid] = req
+            return _Stage(cols, live)
+
+        if isinstance(node, Sort):
+            s = emit(node.child)
+            keys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.keys]
+            specs = [SortSpec(k.ascending, k.nulls_first) for k in node.keys]
+            cols, live = sort_rows(s.cols, s.live, keys, specs)
+            return _Stage(cols, live)
+
+        if isinstance(node, TopN):
+            s = emit(node.child)
+            keys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.keys]
+            specs = [SortSpec(k.ascending, k.nulls_first) for k in node.keys]
+            cols, live = top_n(s.cols, s.live, keys, specs, node.count)
+            return _Stage(cols, live)
+
+        if isinstance(node, Limit):
+            s = emit(node.child)
+            return _Stage(s.cols, limit_mask(s.live, node.count))
+
+        if isinstance(node, Values):
+            nrows = max(len(node.rows), 1)
+            cols = []
+            for ci, t in enumerate(node.types):
+                vals = [r[ci] for r in node.rows]
+                arr = jnp.asarray(np.asarray(vals, dtype=t.np_dtype))
+                cols.append(ColumnVal(arr, None, None, t))
+            live = jnp.asarray(np.arange(nrows) < len(node.rows))
+            if not node.types:
+                live = jnp.ones((len(node.rows) or 1,), jnp.bool_)
+            return _Stage(cols, live)
+
+        raise NotImplementedError(f"node {type(node).__name__}")
+
+    stage = emit(plan)
+    out_page = Page(
+        tuple(
+            Column(cv.type, cv.data, cv.valid, cv.dict)
+            for cv in stage.cols
+        ),
+        stage.live,
+    )
+    return out_page, required
+
+
+def _none_if_all(valid):
+    return valid
+
+
+def _align_join_keys(lkeys: list[ColumnVal], rkeys: list[ColumnVal]):
+    """Translate dictionary codes so both sides of a varchar key share one
+    code space (host-side, trace time)."""
+    out_l, out_r = [], []
+    for a, b in zip(lkeys, rkeys):
+        if a.dict is not None and b.dict is not None and a.dict is not b.dict:
+            trans = np.asarray([a.dict.code_of(v) for v in b.dict.values], dtype=np.int32)
+            new_b = ColumnVal(
+                jnp.take(jnp.asarray(trans), b.data),
+                (b.valid if b.valid is not None else jnp.ones(b.data.shape, jnp.bool_)),
+                a.dict,
+                b.type,
+            )
+            # codes of -1 (absent) must not match: mark invalid
+            new_b = ColumnVal(new_b.data, new_b.valid & (new_b.data >= 0), a.dict, b.type)
+            b = new_b
+        out_l.append(a)
+        out_r.append(b)
+    return out_l, out_r

@@ -1,0 +1,161 @@
+"""Plan optimizer passes.
+
+The reference runs 231 iterative rules over a Memo (sql/planner/iterative/,
+PlanOptimizers.java).  This build's planner already does the load-bearing
+rewrites inline (predicate pushdown, cross-join elimination, decorrelation,
+OR factoring); this module holds the passes that work better as whole-plan
+rewrites.  Current passes:
+
+- prune_columns: projection pushdown all the way into TableScan
+  (reference: PruneUnreferencedOutputs / PruneTableScanColumns rules).
+  Matters doubly on TPU: narrower pages mean fewer HBM-resident arrays
+  gathered through every join.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ir import FieldRef, IrExpr, field_refs, remap
+from .nodes import (
+    Aggregate, AggCall, Distinct, Filter, Join, Limit, PlanNode, Project,
+    Sort, SortKey, TableScan, TopN, Values,
+)
+
+__all__ = ["optimize", "prune_columns"]
+
+
+def optimize(plan: PlanNode) -> PlanNode:
+    plan = prune_columns(plan)
+    return plan
+
+
+def prune_columns(plan: PlanNode) -> PlanNode:
+    new_plan, _ = _prune(plan, set(range(len(plan.output_types))))
+    return new_plan
+
+
+def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
+    """Returns (new_node, mapping old-output-index -> new-output-index).
+    `needed` indices are guaranteed present in the new node's output."""
+
+    if isinstance(node, TableScan):
+        keep = sorted(needed) if needed else [0]  # never emit zero-column scans
+        mapping = {old: i for i, old in enumerate(keep)}
+        new = TableScan(
+            node.catalog,
+            node.table,
+            tuple(node.column_names[i] for i in keep),
+            tuple(node.output_types[i] for i in keep),
+        )
+        return new, mapping
+
+    if isinstance(node, Filter):
+        child_needed = set(needed) | field_refs(node.predicate)
+        child, m = _prune(node.child, child_needed)
+        return Filter(child, remap(node.predicate, m)), m
+
+    if isinstance(node, Project):
+        keep = sorted(needed) if needed else [0]
+        child_needed: set[int] = set()
+        for i in keep:
+            child_needed |= field_refs(node.expressions[i])
+        child, m = _prune(node.child, child_needed)
+        mapping = {old: i for i, old in enumerate(keep)}
+        new = Project(
+            child,
+            tuple(remap(node.expressions[i], m) for i in keep),
+            tuple(node.names[i] for i in keep),
+        )
+        return new, mapping
+
+    if isinstance(node, Aggregate):
+        nk = len(node.group_keys)
+        keep_aggs = sorted(i for i in range(len(node.aggs)) if (nk + i) in needed)
+        child_needed: set[int] = set()
+        for k in node.group_keys:
+            child_needed |= field_refs(k)
+        for i in keep_aggs:
+            if node.aggs[i].arg is not None:
+                child_needed |= field_refs(node.aggs[i].arg)
+        child, m = _prune(node.child, child_needed)
+        new_keys = tuple(remap(k, m) for k in node.group_keys)
+        new_aggs = tuple(
+            AggCall(
+                node.aggs[i].fn,
+                None if node.aggs[i].arg is None else remap(node.aggs[i].arg, m),
+                node.aggs[i].type,
+                node.aggs[i].distinct,
+            )
+            for i in keep_aggs
+        )
+        names = tuple(node.names[i] for i in range(nk)) + tuple(
+            node.names[nk + i] for i in keep_aggs
+        )
+        mapping = {i: i for i in range(nk)}
+        for pos, i in enumerate(keep_aggs):
+            mapping[nk + i] = nk + pos
+        return Aggregate(child, new_keys, new_aggs, names, node.step), mapping
+
+    if isinstance(node, Join):
+        nl = len(node.left.output_types)
+        left_needed = {i for i in needed if i < nl}
+        right_needed = (
+            set()
+            if node.kind in ("semi", "anti")
+            else {i - nl for i in needed if i >= nl}
+        )
+        for k in node.left_keys:
+            left_needed |= field_refs(k)
+        for k in node.right_keys:
+            right_needed |= field_refs(k)
+        if node.residual is not None:
+            for i in field_refs(node.residual):
+                if i < nl:
+                    left_needed.add(i)
+                else:
+                    right_needed.add(i - nl)
+        left, ml = _prune(node.left, left_needed)
+        right, mr = _prune(node.right, right_needed)
+        new_nl = len(left.output_types)
+        concat_map = dict(ml)
+        for old, new in mr.items():
+            concat_map[nl + old] = new_nl + new
+        new = Join(
+            node.kind,
+            left,
+            right,
+            tuple(remap(k, ml) for k in node.left_keys),
+            tuple(remap(k, mr) for k in node.right_keys),
+            None if node.residual is None else remap(node.residual, concat_map),
+            node.distribution,
+        )
+        if node.kind in ("semi", "anti"):
+            return new, ml
+        return new, concat_map
+
+    if isinstance(node, (Sort, TopN)):
+        child_needed = set(needed)
+        for k in node.keys:
+            child_needed |= field_refs(k.expr)
+        child, m = _prune(node.child, child_needed)
+        new_keys = tuple(
+            SortKey(remap(k.expr, m), k.ascending, k.nulls_first) for k in node.keys
+        )
+        if isinstance(node, TopN):
+            return TopN(child, new_keys, node.count), m
+        return Sort(child, new_keys), m
+
+    if isinstance(node, Limit):
+        child, m = _prune(node.child, needed)
+        return Limit(child, node.count), m
+
+    if isinstance(node, Distinct):
+        # DISTINCT is defined over its full input schema: keep everything
+        child, m = _prune(node.child, set(range(len(node.child.output_types))))
+        return Distinct(child), m
+
+    if isinstance(node, Values):
+        return node, {i: i for i in range(len(node.types))}
+
+    raise NotImplementedError(f"prune: {type(node).__name__}")

@@ -1,0 +1,48 @@
+"""Engine facade: SQL string in, rows out.
+
+The single-process counterpart of the reference's coordinator pipeline
+(dispatcher/DispatchManager.createQuery -> SqlQueryExecution.start ->
+LogicalPlanner -> scheduler -> operators), collapsed to:
+parse -> plan (planner.py) -> compile+execute (exec/compiler.py).
+
+The reference's closest analogue is PlanTester/StandaloneQueryRunner
+(testing/PlanTester.java:274): the full engine in-process without HTTP.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..connectors.spi import CatalogManager, Connector
+from ..data.page import Page
+from ..exec.compiler import LocalExecutor
+from ..plan.nodes import PlanNode, format_plan
+from ..plan.planner import Planner
+
+__all__ = ["Engine"]
+
+
+class Engine:
+    def __init__(self, default_catalog: str = "tpch"):
+        self.catalogs = CatalogManager()
+        self.default_catalog = default_catalog
+        self.planner = Planner(self.catalogs, default_catalog)
+        self.executor = LocalExecutor(self.catalogs, default_catalog)
+
+    def register_catalog(self, name: str, connector: Connector) -> None:
+        self.catalogs.register(name, connector)
+
+    def plan(self, sql: str) -> PlanNode:
+        from ..plan.optimizer import optimize
+
+        return optimize(self.planner.plan(sql))
+
+    def explain(self, sql: str) -> str:
+        return format_plan(self.plan(sql))
+
+    def execute_page(self, sql: str) -> Page:
+        return self.executor.execute(self.plan(sql))
+
+    def query(self, sql: str) -> list[tuple]:
+        """Run a query, return rows as python tuples (None == NULL)."""
+        return self.execute_page(sql).to_pylist()
