@@ -34,8 +34,8 @@ from ..ops.relops import (
     limit_mask, sort_rows, top_n,
 )
 from ..plan.nodes import (
-    Aggregate, Distinct, Filter, Join, Limit, PlanNode, Project, Sort,
-    TableScan, TopN, Values,
+    Aggregate, Distinct, Exchange, Filter, Join, Limit, PlanNode, Project,
+    Sort, TableScan, TopN, Values,
 )
 
 __all__ = ["LocalExecutor"]
@@ -178,9 +178,23 @@ def _child_ids(nodes: dict[int, PlanNode], nid: int) -> list[int]:
     return ids
 
 
-def _trace_plan(plan: PlanNode, pages: dict[str, Page], caps: dict[int, int]):
+def _trace_plan(
+    plan: PlanNode,
+    pages: dict[str, Page],
+    caps: dict[int, int],
+    num_devices: int = 1,
+    axis: Optional[str] = None,
+):
+    """Trace a plan into jax ops.  With `axis` set, the trace happens inside
+    shard_map and Exchange nodes lower to collectives (parallel/exchange.py);
+    overflow counters are pmax-reduced so every device agrees on retries."""
     required: dict[int, jnp.ndarray] = {}
     counter = [0]
+
+    def report(nid: int, value):
+        if axis is not None:
+            value = jax.lax.pmax(value, axis)
+        required[nid] = value
 
     def emit(node: PlanNode) -> _Stage:
         nid = counter[0]
@@ -215,7 +229,7 @@ def _trace_plan(plan: PlanNode, pages: dict[str, Page], caps: dict[int, int]):
             out_keys, out_aggs, out_live, n_groups = group_aggregate(
                 keys, args, specs, s.live, G
             )
-            required[nid] = n_groups
+            report(nid, n_groups)
             cols: list[ColumnVal] = []
             for (data, valid), kv in zip(out_keys, keys):
                 cols.append(ColumnVal(data, _none_if_all(valid), kv.dict, kv.type))
@@ -230,7 +244,7 @@ def _trace_plan(plan: PlanNode, pages: dict[str, Page], caps: dict[int, int]):
             out_keys, _, out_live, n_groups = group_aggregate(
                 s.cols, [], [], s.live, G
             )
-            required[nid] = n_groups
+            report(nid, n_groups)
             cols = [
                 ColumnVal(data, _none_if_all(valid), cv.dict, cv.type)
                 for (data, valid), cv in zip(out_keys, s.cols)
@@ -260,7 +274,7 @@ def _trace_plan(plan: PlanNode, pages: dict[str, Page], caps: dict[int, int]):
                 node.kind, left.cols, left.live, right.cols, right.live,
                 lkeys, rkeys, residual, C,
             )
-            required[nid] = req
+            report(nid, req)
             return _Stage(cols, live)
 
         if isinstance(node, Sort):
@@ -280,6 +294,24 @@ def _trace_plan(plan: PlanNode, pages: dict[str, Page], caps: dict[int, int]):
         if isinstance(node, Limit):
             s = emit(node.child)
             return _Stage(s.cols, limit_mask(s.live, node.count))
+
+        if isinstance(node, Exchange):
+            s = emit(node.child)
+            if node.kind in ("gather", "broadcast"):
+                from ..parallel.exchange import gather_all
+
+                cols, live = gather_all(s.cols, s.live, axis)
+                return _Stage(cols, live)
+            # repartition
+            from ..parallel.exchange import repartition
+
+            keys = [eval_expr(k, s.cols, s.capacity) for k in node.keys]
+            B = caps[nid]
+            cols, live, req = repartition(
+                s.cols, s.live, keys, num_devices, B, axis
+            )
+            report(nid, req)
+            return _Stage(cols, live)
 
         if isinstance(node, Values):
             nrows = max(len(node.rows), 1)

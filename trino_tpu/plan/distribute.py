@@ -1,0 +1,335 @@
+"""Distributed planning: insert Exchange nodes + split aggregations.
+
+The reference's AddExchanges (sql/planner/optimizations/AddExchanges.java:143)
+walks the plan inserting REMOTE REPARTITION / REPLICATE / GATHER exchanges and
+splitting aggregations into partial/final around them; join distribution
+(partitioned vs broadcast) is cost-chosen (DetermineJoinDistributionType.java
+:51).  This pass does the same over the SPMD model:
+
+- every operator runs on all D devices over local shards (scans are split
+  round-robin by the executor);
+- `Exchange(repartition, keys)` hash-routes rows across devices (all_to_all
+  over ICI), `broadcast`/`gather` replicate (all_gather);
+- Aggregate splits into partial (pre-exchange, local) and final
+  (post-exchange), with avg decomposed into sum+count and the division
+  re-applied by a Project (the reference's partial/final accumulator states);
+- join distribution is picked from connector row-count estimates: small build
+  sides broadcast, large ones repartition both inputs;
+- tracked output partitioning elides exchanges when data is already
+  co-located (e.g. GROUP BY on the join key just joined on).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..connectors.spi import CatalogManager
+from ..data.types import BIGINT, DOUBLE
+from .ir import Call, Const, FieldRef, IrExpr
+from .nodes import (
+    AggCall, Aggregate, Distinct, Exchange, Filter, Join, Limit, PlanNode,
+    Project, Sort, TableScan, TopN, Values,
+)
+
+__all__ = ["distribute"]
+
+_BROADCAST_LIMIT = 100_000  # est. rows below which a build side is replicated
+
+
+@dataclass(frozen=True)
+class _Part:
+    """Tracked partitioning of a node's output.
+    kind: any (arbitrary/source) | hash | replicated | single"""
+
+    kind: str
+    keys: tuple[IrExpr, ...] = ()
+
+
+def distribute(plan: PlanNode, catalogs: CatalogManager, num_devices: int) -> PlanNode:
+    """Rewrite a single-node plan into an SPMD plan for `num_devices`."""
+    if num_devices <= 1:
+        return plan
+    d = _Distributor(catalogs)
+    node, part = d.visit(plan)
+    if part.kind != "replicated":
+        node = Exchange(node, "gather")
+        node = _re_finalize(node, plan)
+    return node
+
+
+def _re_finalize(node: PlanNode, original: PlanNode) -> PlanNode:
+    """After the final gather, re-apply order/limit that local stages only
+    enforced per-shard."""
+    if isinstance(original, TopN):
+        return TopN(node, original.keys, original.count)
+    if isinstance(original, Sort):
+        return Sort(node, original.keys)
+    if isinstance(original, Limit):
+        return Limit(node, original.count)
+    return node
+
+
+class _Distributor:
+    def __init__(self, catalogs: CatalogManager):
+        self.catalogs = catalogs
+
+    # ------------------------------------------------------------ size model
+    def est_rows(self, node: PlanNode) -> float:
+        if isinstance(node, TableScan):
+            conn = self.catalogs.get(node.catalog)
+            n = conn.estimated_row_count(node.table)
+            return float(n if n is not None else 1_000_000)
+        if isinstance(node, Filter):
+            return 0.3 * self.est_rows(node.child)
+        if isinstance(node, (Project, Exchange, Sort)):
+            return self.est_rows(node.child)
+        if isinstance(node, Aggregate):
+            return max(1.0, 0.1 * self.est_rows(node.child))
+        if isinstance(node, Distinct):
+            return max(1.0, 0.5 * self.est_rows(node.child))
+        if isinstance(node, Join):
+            if node.kind in ("semi", "anti"):
+                return self.est_rows(node.left)
+            if node.kind == "cross":
+                return self.est_rows(node.left)
+            return max(self.est_rows(node.left), self.est_rows(node.right))
+        if isinstance(node, (TopN, Limit)):
+            return float(min(node.count, int(self.est_rows(node.child))))
+        if isinstance(node, Values):
+            return float(len(node.rows))
+        return 1_000_000.0
+
+    # --------------------------------------------------------------- visitor
+    def visit(self, node: PlanNode) -> tuple[PlanNode, _Part]:
+        if isinstance(node, TableScan):
+            return node, _Part("any")
+        if isinstance(node, Values):
+            return node, _Part("replicated")
+
+        if isinstance(node, Filter):
+            child, part = self.visit(node.child)
+            return Filter(child, node.predicate), part
+
+        if isinstance(node, Project):
+            child, part = self.visit(node.child)
+            return Project(child, node.expressions, node.names), _project_part(
+                part, node
+            )
+
+        if isinstance(node, Aggregate):
+            return self._visit_aggregate(node)
+
+        if isinstance(node, Distinct):
+            child, part = self.visit(node.child)
+            keys = tuple(
+                FieldRef(i, t) for i, t in enumerate(node.child.output_types)
+            )
+            if part.kind == "replicated":
+                return Distinct(child), part
+            # local pre-distinct shrinks the exchange, then exact distinct
+            local = Distinct(child)
+            exch = Exchange(local, "repartition", keys)
+            return Distinct(exch), _Part("hash", keys)
+
+        if isinstance(node, Join):
+            return self._visit_join(node)
+
+        if isinstance(node, TopN):
+            child, part = self.visit(node.child)
+            if part.kind == "replicated":
+                return TopN(child, node.keys, node.count), part
+            local = TopN(child, node.keys, node.count)
+            exch = Exchange(local, "gather")
+            return TopN(exch, node.keys, node.count), _Part("replicated")
+
+        if isinstance(node, Sort):
+            child, part = self.visit(node.child)
+            if part.kind == "replicated":
+                return Sort(child, node.keys), part
+            exch = Exchange(child, "gather")
+            return Sort(exch, node.keys), _Part("replicated")
+
+        if isinstance(node, Limit):
+            child, part = self.visit(node.child)
+            if part.kind == "replicated":
+                return Limit(child, node.count), part
+            local = Limit(child, node.count)
+            exch = Exchange(local, "gather")
+            return Limit(exch, node.count), _Part("replicated")
+
+        raise NotImplementedError(f"distribute: {type(node).__name__}")
+
+    # ------------------------------------------------------------- aggregate
+    def _visit_aggregate(self, node: Aggregate) -> tuple[PlanNode, _Part]:
+        child, part = self.visit(node.child)
+        nk = len(node.group_keys)
+
+        if part.kind == "replicated":
+            return (
+                Aggregate(child, node.group_keys, node.aggs, node.names, "single"),
+                part,
+            )
+
+        # already partitioned on a subset of the group keys: aggregate locally
+        if (
+            part.kind == "hash"
+            and nk > 0
+            and all(any(k == g for g in node.group_keys) for k in part.keys)
+        ):
+            return (
+                Aggregate(child, node.group_keys, node.aggs, node.names, "single"),
+                part,
+            )
+
+        has_distinct = any(a.distinct for a in node.aggs)
+        if has_distinct:
+            # repartition raw rows on the group keys, then aggregate once
+            exch = Exchange(child, "repartition", node.group_keys)
+            out = Aggregate(exch, node.group_keys, node.aggs, node.names, "single")
+            return out, _Part("hash", _output_key_refs(node))
+
+        # partial -> exchange -> final (+ avg fix-up projection)
+        partial_aggs: list[AggCall] = []
+        partial_names: list[str] = list(node.names[:nk])
+        slots: list[tuple[int, ...]] = []  # per original agg: partial col indices
+        for a in node.aggs:
+            base = nk + len(partial_aggs)
+            if a.fn == "avg":
+                partial_aggs.append(AggCall("sum", a.arg, DOUBLE))
+                partial_aggs.append(AggCall("count", a.arg, BIGINT))
+                partial_names += [f"_p{base}", f"_p{base + 1}"]
+                slots.append((base, base + 1))
+            elif a.fn == "count_star":
+                partial_aggs.append(AggCall("count_star", None, BIGINT))
+                partial_names.append(f"_p{base}")
+                slots.append((base,))
+            else:
+                partial_aggs.append(AggCall(a.fn, a.arg, a.type))
+                partial_names.append(f"_p{base}")
+                slots.append((base,))
+        partial = Aggregate(
+            child,
+            node.group_keys,
+            tuple(partial_aggs),
+            tuple(partial_names),
+            "partial",
+        )
+        key_refs = tuple(FieldRef(i, k.type) for i, k in enumerate(node.group_keys))
+        if nk > 0:
+            exch = Exchange(partial, "repartition", key_refs)
+            out_part = _Part("hash", key_refs)
+        else:
+            exch = Exchange(partial, "gather")
+            out_part = _Part("replicated")
+
+        # final step over the partial schema
+        final_aggs: list[AggCall] = []
+        for (a, slot) in zip(node.aggs, slots):
+            if a.fn == "avg":
+                final_aggs.append(
+                    AggCall("sum", FieldRef(slot[0], DOUBLE), DOUBLE)
+                )
+                final_aggs.append(
+                    AggCall("sum", FieldRef(slot[1], BIGINT), BIGINT)
+                )
+            elif a.fn in ("count", "count_star"):
+                final_aggs.append(AggCall("sum", FieldRef(slot[0], BIGINT), BIGINT))
+            else:  # sum/min/max combine with themselves
+                final_aggs.append(AggCall(a.fn, FieldRef(slot[0], a.type), a.type))
+        final = Aggregate(
+            exch,
+            key_refs,
+            tuple(final_aggs),
+            tuple(f"_f{i}" for i in range(nk + len(final_aggs))),
+            "final",
+        )
+
+        # fix-up projection back to the original schema (avg division,
+        # count null->0 handled by sum validity rules)
+        exprs: list[IrExpr] = [
+            FieldRef(i, node.group_keys[i].type) for i in range(nk)
+        ]
+        fpos = nk
+        for a in node.aggs:
+            if a.fn == "avg":
+                s = FieldRef(fpos, DOUBLE)
+                c = FieldRef(fpos + 1, BIGINT)
+                exprs.append(Call("div", (s, Call("cast", (c,), DOUBLE)), DOUBLE))
+                fpos += 2
+            elif a.fn in ("count", "count_star"):
+                # count over zero partials must be 0, not NULL
+                exprs.append(
+                    Call("coalesce", (FieldRef(fpos, BIGINT), Const(0, BIGINT)), BIGINT)
+                )
+                fpos += 1
+            else:
+                exprs.append(FieldRef(fpos, a.type))
+                fpos += 1
+        proj = Project(final, tuple(exprs), node.names)
+        return proj, (out_part if nk > 0 else _Part("replicated"))
+
+    # ------------------------------------------------------------------ join
+    def _visit_join(self, node: Join) -> tuple[PlanNode, _Part]:
+        left, lpart = self.visit(node.left)
+        right, rpart = self.visit(node.right)
+
+        if node.kind == "cross":
+            # single-row right (scalar subquery): must be replicated
+            if rpart.kind != "replicated":
+                right = Exchange(right, "gather")
+            return (
+                Join("cross", left, right, (), (), None, "broadcast"),
+                lpart,
+            )
+
+        est_right = self.est_rows(node.right)
+        varchar_keys = any(k.type.is_string for k in node.left_keys)
+        broadcast = (
+            est_right <= _BROADCAST_LIMIT
+            or varchar_keys
+            or not node.left_keys
+            or rpart.kind == "replicated"
+        )
+
+        if broadcast:
+            if rpart.kind != "replicated":
+                right = Exchange(right, "broadcast")
+            out = Join(
+                node.kind, left, right, node.left_keys, node.right_keys,
+                node.residual, "broadcast",
+            )
+            return out, lpart
+
+        # partitioned join: co-locate both sides on the join keys
+        if not (lpart.kind == "hash" and lpart.keys == node.left_keys):
+            left = Exchange(left, "repartition", node.left_keys)
+        if not (rpart.kind == "hash" and rpart.keys == node.right_keys):
+            right = Exchange(right, "repartition", node.right_keys)
+        out = Join(
+            node.kind, left, right, node.left_keys, node.right_keys,
+            node.residual, "partitioned",
+        )
+        return out, _Part("hash", node.left_keys)
+
+
+def _output_key_refs(node: Aggregate) -> tuple[IrExpr, ...]:
+    return tuple(FieldRef(i, k.type) for i, k in enumerate(node.group_keys))
+
+
+def _project_part(part: _Part, node: Project) -> _Part:
+    """Track hash partitioning through a projection: keys survive if each key
+    expression appears verbatim as a projected expression."""
+    if part.kind != "hash":
+        return part
+    new_keys = []
+    for k in part.keys:
+        hit = None
+        for i, e in enumerate(node.expressions):
+            if e == k:
+                hit = FieldRef(i, e.type)
+                break
+        if hit is None:
+            return _Part("any")
+        new_keys.append(hit)
+    return _Part("hash", tuple(new_keys))

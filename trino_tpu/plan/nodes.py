@@ -22,6 +22,7 @@ from .ir import IrExpr
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
     "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
+    "Exchange",
 ]
 
 
@@ -249,6 +250,35 @@ class Distinct(PlanNode):
 
 
 @dataclass(frozen=True)
+class Exchange(PlanNode):
+    """Data redistribution boundary (reference: ExchangeNode inserted by
+    AddExchanges.java:143; physically PartitionedOutputOperator -> HTTP ->
+    ExchangeOperator).  On TPU this lowers to XLA collectives over ICI inside
+    the jitted SPMD step (exec/spmd.py):
+
+      repartition -> hash(keys) % D routing + lax.all_to_all
+      broadcast   -> lax.all_gather (build side of replicated joins)
+      gather      -> lax.all_gather (root stage / global aggregation)
+    """
+
+    child: PlanNode
+    kind: str  # repartition | broadcast | gather
+    keys: tuple[IrExpr, ...] = ()  # hash keys for repartition
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
 class Values(PlanNode):
     """Literal rows (reference: ValuesNode)."""
 
@@ -296,6 +326,10 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
             detail += f" count={node.count}"
     elif isinstance(node, Limit):
         detail = f" count={node.count}"
+    elif isinstance(node, Exchange):
+        detail = f" {node.kind}" + (
+            f" keys={[str(k) for k in node.keys]}" if node.keys else ""
+        )
     lines = [f"{pad}{label}{detail}"]
     for c in node.children:
         lines.append(format_plan(c, indent + 1))

@@ -23,11 +23,26 @@ __all__ = ["Engine"]
 
 
 class Engine:
-    def __init__(self, default_catalog: str = "tpch"):
+    """distributed=True runs every query SPMD over `devices` (default: all
+    jax.devices()) with exchange collectives — the in-process analogue of the
+    reference's DistributedQueryRunner (N servers, loopback HTTP)."""
+
+    def __init__(
+        self,
+        default_catalog: str = "tpch",
+        distributed: bool = False,
+        devices=None,
+    ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
         self.planner = Planner(self.catalogs, default_catalog)
-        self.executor = LocalExecutor(self.catalogs, default_catalog)
+        if distributed:
+            from ..exec.spmd import SpmdExecutor
+
+            self.executor = SpmdExecutor(self.catalogs, default_catalog, devices)
+        else:
+            self.executor = LocalExecutor(self.catalogs, default_catalog)
+        self.distributed = distributed
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -35,7 +50,12 @@ class Engine:
     def plan(self, sql: str) -> PlanNode:
         from ..plan.optimizer import optimize
 
-        return optimize(self.planner.plan(sql))
+        plan = optimize(self.planner.plan(sql))
+        if self.distributed:
+            from ..plan.distribute import distribute
+
+            plan = distribute(plan, self.catalogs, self.executor.num_devices)
+        return plan
 
     def explain(self, sql: str) -> str:
         return format_plan(self.plan(sql))
