@@ -145,13 +145,16 @@ class SpmdExecutor(LocalExecutor):
             def step(pages):
                 return _trace_plan(plan, pages, caps, D, AXIS)
 
-            smapped = shard_map(
-                step,
-                mesh=mesh,
-                in_specs=(P(AXIS),),
-                out_specs=P(),
-                check_rep=False,
-            )
+            try:
+                smapped = shard_map(
+                    step, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(),
+                    check_vma=False,
+                )
+            except TypeError:  # pre-0.8 jax uses check_rep
+                smapped = shard_map(
+                    step, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(),
+                    check_rep=False,
+                )
             self._jit_cache[cache_key] = jax.jit(lambda pages: smapped(pages))
         out_page, required = self._jit_cache[cache_key](inputs)
         return out_page, {k: int(v) for k, v in required.items()}
