@@ -76,30 +76,32 @@ class LocalExecutor:
     def __init__(self, catalogs: CatalogManager, default_catalog: str = "tpch"):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
-        self._table_pages: dict[tuple[str, str], Page] = {}
+        self._table_cols: dict[tuple[str, str, str], Column] = {}
         self._jit_cache: dict = {}
+        # caps that completed a query without overflow, keyed by plan: repeat
+        # executions skip the growth retries (the reference's runtime-adaptive
+        # statistics feedback, AdaptivePlanner, in miniature)
+        self._learned_caps: dict[PlanNode, dict[int, int]] = {}
 
     # ------------------------------------------------------------- table IO
     def table_page(self, catalog: str, table: str, columns: Sequence[str], types) -> Page:
-        key = (catalog, table)
-        if key not in self._table_pages:
-            conn = self.catalogs.get(catalog)
-            schema = conn.table_schema(table)
-            splits = conn.get_splits(table, 1)
-            all_cols = schema.column_names()
-            data = conn.read_split(splits[0], all_cols)
-            for s in splits[1:]:
-                more = conn.read_split(s, all_cols)
-                data = {c: np.concatenate([data[c], more[c]]) for c in all_cols}
-            page = Page.from_numpy(
-                [schema.type_of(c) for c in all_cols], [data[c] for c in all_cols]
-            )
-            self._table_pages[key] = page
-        page = self._table_pages[key]
+        """Device page for the pruned column set; columns are materialized and
+        uploaded lazily, once each (the scan-level projection pushdown the
+        reference does via ConnectorPageSource lazy blocks)."""
         conn = self.catalogs.get(catalog)
         schema = conn.table_schema(table)
-        idx = [schema.column_index(c) for c in columns]
-        return page.select_columns(idx)
+        missing = [c for c in columns if (catalog, table, c) not in self._table_cols]
+        if missing:
+            splits = conn.get_splits(table, 1)
+            data = conn.read_split(splits[0], missing)
+            for s in splits[1:]:
+                more = conn.read_split(s, missing)
+                data = {c: np.concatenate([data[c], more[c]]) for c in missing}
+            for c in missing:
+                self._table_cols[(catalog, table, c)] = Column.from_numpy(
+                    schema.type_of(c), data[c]
+                )
+        return Page(tuple(self._table_cols[(catalog, table, c)] for c in columns))
 
     # ------------------------------------------------------------ execution
     def execute(self, plan: PlanNode) -> Page:
@@ -109,13 +111,16 @@ class LocalExecutor:
             str(i): self.table_page(n.catalog, n.table, n.column_names, n.output_types)
             for i, n in scans.items()
         }
-        caps = self._initial_caps(nodes, inputs)
+        caps = self._learned_caps.get(plan) or self._initial_caps(nodes, inputs)
         for _ in range(12):  # capacity-retry loop
             out_page, required = self._run(plan, inputs, caps)
             overflow = {
-                nid: int(req) for nid, req in required.items() if int(req) > caps[nid]
+                nid: int(req)
+                for nid, req in required.items()
+                if nid in caps and int(req) > caps[nid]
             }
             if not overflow:
+                self._learned_caps[plan] = caps
                 return out_page
             for nid, req in overflow.items():
                 caps[nid] = _pow2(max(req, caps[nid] * 2))
@@ -134,11 +139,10 @@ class LocalExecutor:
                 return inputs[str(nid)].capacity
             child_ids = _child_ids(nodes, nid)
             child_sizes = [size_of(c, nodes[c]) for c in child_ids]
-            if isinstance(n, Aggregate):
-                caps[nid] = _pow2(max(child_sizes[0], 1))
-                return caps[nid]
-            if isinstance(n, Distinct):
-                caps[nid] = _pow2(max(child_sizes[0], 1))
+            if isinstance(n, (Aggregate, Distinct)):
+                # optimistic: most group-bys collapse hard; the retry loop
+                # (with the learned-caps memo) corrects high-cardinality ones
+                caps[nid] = min(_pow2(max(child_sizes[0], 1)), 65536)
                 return caps[nid]
             if isinstance(n, Join):
                 if n.kind in ("semi", "anti"):
@@ -165,7 +169,7 @@ class LocalExecutor:
                 lambda pages: _trace_plan(plan, pages, caps)
             )
         out_page, required = self._jit_cache[cache_key](inputs)
-        return out_page, {k: int(v) for k, v in required.items()}
+        return out_page, jax.device_get(required)  # one transfer, not one per scalar
 
 
 def _child_ids(nodes: dict[int, PlanNode], nid: int) -> list[int]:

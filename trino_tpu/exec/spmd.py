@@ -80,13 +80,16 @@ class SpmdExecutor(LocalExecutor):
         nodes = _node_ids(plan)
         scans = {i: n for i, n in nodes.items() if isinstance(n, TableScan)}
         inputs = {str(i): self.sharded_table_page(n) for i, n in scans.items()}
-        caps = self._initial_caps_spmd(nodes, inputs)
+        caps = self._learned_caps.get(plan) or self._initial_caps_spmd(nodes, inputs)
         for _ in range(14):
             out_page, required = self._run_spmd(plan, inputs, caps)
             overflow = {
-                nid: int(req) for nid, req in required.items() if int(req) > caps[nid]
+                nid: int(req)
+                for nid, req in required.items()
+                if nid in caps and int(req) > caps[nid]
             }
             if not overflow:
+                self._learned_caps[plan] = caps
                 return out_page
             for nid, req in overflow.items():
                 caps[nid] = _pow2(max(req, caps[nid] * 2))
@@ -157,4 +160,4 @@ class SpmdExecutor(LocalExecutor):
                 )
             self._jit_cache[cache_key] = jax.jit(lambda pages: smapped(pages))
         out_page, required = self._jit_cache[cache_key](inputs)
-        return out_page, {k: int(v) for k, v in required.items()}
+        return out_page, jax.device_get(required)
