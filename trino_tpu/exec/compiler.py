@@ -35,7 +35,7 @@ from ..ops.relops import (
 )
 from ..plan.nodes import (
     Aggregate, Distinct, Exchange, Filter, Join, Limit, PlanNode, Project,
-    Sort, TableScan, TopN, Values,
+    Sort, TableScan, TopN, Values, Window,
 )
 
 __all__ = ["LocalExecutor"]
@@ -298,6 +298,22 @@ def _trace_plan(
         if isinstance(node, Limit):
             s = emit(node.child)
             return _Stage(s.cols, limit_mask(s.live, node.count))
+
+        if isinstance(node, Window):
+            from ..ops.window import window_eval
+
+            s = emit(node.child)
+            part = [eval_expr(k, s.cols, s.capacity) for k in node.partition_by]
+            okeys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.order_by]
+            ospecs = [SortSpec(k.ascending, k.nulls_first) for k in node.order_by]
+            argv = [
+                tuple(eval_expr(a, s.cols, s.capacity) for a in c.args)
+                for c in node.calls
+            ]
+            cols, live = window_eval(
+                s.cols, s.live, part, okeys, ospecs, node.calls, argv
+            )
+            return _Stage(cols, live)
 
         if isinstance(node, Exchange):
             s = emit(node.child)

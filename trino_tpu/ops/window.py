@@ -1,0 +1,224 @@
+"""Window function kernel.
+
+The reference's WindowOperator (operator/WindowOperator.java, window/
+framework 6.9k LoC) indexes each partition in a PagesIndex and walks frames
+row by row.  The TPU formulation is one sort + segmented scans:
+
+  sort rows by (partition keys, order keys)
+  -> partition/peer boundary flags
+  -> jax.lax.associative_scan with a reset-at-boundary combiner for running
+     sum/count/min/max and ranks (log-depth, fully vectorized)
+  -> reverse scans give partition/peer END indices for RANGE frames (peers),
+     whole-partition values, and last_value; gathers fetch frame results.
+
+All frames supported are prefix frames: 'rows' (UNBOUNDED PRECEDING ..
+CURRENT ROW), 'range' (same, peers included — SQL default), 'whole'
+(the full partition).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .expr import ColumnVal
+from .relops import SortSpec, _sortable_key, _valid_of
+
+__all__ = ["window_eval"]
+
+
+def _seg_scan(op: str, x: jnp.ndarray, boundary: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive segmented scan: restarts at rows where boundary is True
+    (boundary[0] must be True)."""
+
+    def combine(a, b):
+        av, ab = a
+        bv, bb = b
+        if op == "add":
+            val = jnp.where(bb, bv, av + bv)
+        elif op == "max":
+            val = jnp.where(bb, bv, jnp.maximum(av, bv))
+        else:
+            val = jnp.where(bb, bv, jnp.minimum(av, bv))
+        return val, ab | bb
+
+    out, _ = jax.lax.associative_scan(combine, (x, boundary))
+    return out
+
+
+def _end_indices(is_end: jnp.ndarray) -> jnp.ndarray:
+    """For each row, the index of the next row (inclusive) where is_end."""
+    n = is_end.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    flipped = jnp.flip(idx)
+    fboundary = jnp.flip(is_end)
+    ends = _seg_scan("max", flipped, fboundary)
+    return jnp.flip(ends)
+
+
+def window_eval(
+    cols: Sequence[ColumnVal],
+    live: jnp.ndarray,
+    part_keys: Sequence[ColumnVal],
+    order_keys: Sequence[ColumnVal],
+    order_specs: Sequence[SortSpec],
+    calls,  # Sequence[WindowCall]
+    arg_vals: Sequence[tuple[ColumnVal, ...]],
+):
+    """Returns (cols ++ one ColumnVal per call, live) in window-sorted order."""
+    n = live.shape[0]
+
+    # ---- sort by (dead-last, partition keys, order keys) -------------------
+    operands: list[jnp.ndarray] = [(~live).astype(jnp.int8)]
+    for kv in part_keys:
+        operands.append(~_valid_of(kv, n))
+        operands.append(_sortable_key(kv))
+    n_part_ops = len(operands) - 1
+    for kv, spec in zip(order_keys, order_specs):
+        null_flag = _valid_of(kv, n) if spec.nulls_first else ~_valid_of(kv, n)
+        operands.append(null_flag.astype(jnp.int8))
+        operands.append(_sortable_key(kv, descending=not spec.ascending))
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(operands + [iota], num_keys=len(operands), is_stable=True)
+    perm = sorted_ops[-1]
+    live_s = jnp.take(live, perm)
+
+    def gather(cv: ColumnVal) -> ColumnVal:
+        return ColumnVal(
+            jnp.take(cv.data, perm),
+            None if cv.valid is None else jnp.take(cv.valid, perm),
+            cv.dict,
+            cv.type,
+        )
+
+    out_cols = [gather(cv) for cv in cols]
+
+    # ---- boundaries --------------------------------------------------------
+    first = jnp.zeros((n,), jnp.bool_).at[0].set(True)
+    part_ops = sorted_ops[1 : 1 + n_part_ops]
+    new_part = first
+    for op_arr in part_ops:
+        prev = jnp.concatenate([op_arr[:1], op_arr[:-1]])
+        new_part = new_part | (op_arr != prev)
+    order_ops = sorted_ops[1 + n_part_ops : -1]
+    new_peer = new_part
+    for op_arr in order_ops:
+        prev = jnp.concatenate([op_arr[:1], op_arr[:-1]])
+        new_peer = new_peer | (op_arr != prev)
+
+    is_part_end = jnp.concatenate([new_part[1:], jnp.ones((1,), jnp.bool_)])
+    is_peer_end = jnp.concatenate([new_peer[1:], jnp.ones((1,), jnp.bool_)])
+    part_end = _end_indices(is_part_end)
+    peer_end = _end_indices(is_peer_end)
+    ones = jnp.ones((n,), jnp.int64)
+    row_number = _seg_scan("add", ones, new_part)
+
+    # ---- evaluate calls ----------------------------------------------------
+    for call, argv in zip(calls, arg_vals):
+        argv = [gather(a) for a in argv]
+        out_cols.append(
+            _eval_call(
+                call, argv, n, new_part, new_peer, part_end, peer_end,
+                row_number, live_s,
+            )
+        )
+    return out_cols, live_s
+
+
+def _eval_call(call, argv, n, new_part, new_peer, part_end, peer_end, row_number, live_s):
+    from ..data.types import BIGINT
+
+    fn = call.fn
+    if fn == "row_number":
+        return ColumnVal(row_number, None, None, call.type)
+    if fn == "rank":
+        # rank = row_number at the start of the peer group
+        start_rn = jnp.where(new_peer, row_number, jnp.int64(0))
+        rank = _seg_scan("max", start_rn, new_part)
+        return ColumnVal(rank, None, None, call.type)
+    if fn == "dense_rank":
+        dr = _seg_scan("add", new_peer.astype(jnp.int64), new_part)
+        return ColumnVal(dr, None, None, call.type)
+    if fn in ("lag", "lead"):
+        a = argv[0]
+        k = int(argv[1].data[0]) if len(argv) > 1 else 1
+        shift = -k if fn == "lag" else k
+        data = jnp.roll(a.data, -shift)
+        valid = jnp.roll(_valid_of(a, n), -shift)
+        # valid only if the source row is in the same partition
+        pid = jnp.cumsum(new_part.astype(jnp.int32))
+        src_pid = jnp.roll(pid, -shift)
+        idx = jnp.arange(n)
+        in_range = (idx + shift >= 0) & (idx + shift < n)
+        ok = valid & (pid == src_pid) & in_range
+        if len(argv) > 2:  # lag(x, k, default)
+            dflt = argv[2]
+            data = jnp.where(ok, data, dflt.data.astype(data.dtype))
+            ok = ok | _valid_of(dflt, n)
+        return ColumnVal(data, ok, a.dict, call.type)
+    if fn == "first_value":
+        a = argv[0]
+        # value at partition start: running 'carry first' via masked max of idx
+        idx = jnp.arange(n, dtype=jnp.int32)
+        start_idx = _seg_scan("max", jnp.where(new_part, idx, -1), new_part)
+        data = jnp.take(a.data, start_idx)
+        valid = None if a.valid is None else jnp.take(a.valid, start_idx)
+        return ColumnVal(data, valid, a.dict, call.type)
+    if fn == "last_value":
+        a = argv[0]
+        end = part_end if call.frame == "whole" else peer_end
+        data = jnp.take(a.data, end)
+        valid = None if a.valid is None else jnp.take(a.valid, end)
+        return ColumnVal(data, valid, a.dict, call.type)
+
+    # aggregates over a prefix frame ----------------------------------------
+    if fn == "count_star":
+        running = _seg_scan("add", live_s.astype(jnp.int64), new_part)
+        return ColumnVal(_frame_value(running, call.frame, part_end, peer_end), None, None, call.type)
+
+    a = argv[0]
+    valid = _valid_of(a, n) & live_s
+    if fn == "count":
+        running = _seg_scan("add", valid.astype(jnp.int64), new_part)
+        return ColumnVal(_frame_value(running, call.frame, part_end, peer_end), None, None, call.type)
+    if fn in ("sum", "avg"):
+        acc_t = (
+            jnp.float64
+            if (fn == "avg" or jnp.issubdtype(a.data.dtype, jnp.floating))
+            else jnp.int64
+        )
+        contrib = jnp.where(valid, a.data.astype(acc_t), jnp.zeros((n,), acc_t))
+        rsum = _seg_scan("add", contrib, new_part)
+        rcnt = _seg_scan("add", valid.astype(jnp.int64), new_part)
+        s = _frame_value(rsum, call.frame, part_end, peer_end)
+        c = _frame_value(rcnt, call.frame, part_end, peer_end)
+        if fn == "sum":
+            return ColumnVal(s, c > 0, None, call.type)
+        return ColumnVal(
+            s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64),
+            c > 0, None, call.type,
+        )
+    if fn in ("min", "max"):
+        if a.dict is not None:
+            raise NotImplementedError("window min/max over varchar")
+        if jnp.issubdtype(a.data.dtype, jnp.floating):
+            sent = jnp.asarray(jnp.inf if fn == "min" else -jnp.inf, a.data.dtype)
+        else:
+            info = jnp.iinfo(a.data.dtype)
+            sent = jnp.asarray(info.max if fn == "min" else info.min, a.data.dtype)
+        x = jnp.where(valid, a.data, sent)
+        r = _seg_scan("min" if fn == "min" else "max", x, new_part)
+        rc = _seg_scan("add", valid.astype(jnp.int64), new_part)
+        v = _frame_value(r, call.frame, part_end, peer_end)
+        c = _frame_value(rc, call.frame, part_end, peer_end)
+        return ColumnVal(v, c > 0, None, call.type)
+    raise NotImplementedError(f"window function {fn}")
+
+
+def _frame_value(running: jnp.ndarray, frame: str, part_end, peer_end):
+    if frame == "rows":
+        return running
+    end = part_end if frame == "whole" else peer_end
+    return jnp.take(running, end)

@@ -29,7 +29,7 @@ from ..data.types import BIGINT, DOUBLE
 from .ir import Call, Const, FieldRef, IrExpr
 from .nodes import (
     AggCall, Aggregate, Distinct, Exchange, Filter, Join, Limit, PlanNode,
-    Project, Sort, TableScan, TopN, Values,
+    Project, Sort, TableScan, TopN, Values, Window,
 )
 
 __all__ = ["distribute"]
@@ -82,7 +82,7 @@ class _Distributor:
             return float(n if n is not None else 1_000_000)
         if isinstance(node, Filter):
             return 0.3 * self.est_rows(node.child)
-        if isinstance(node, (Project, Exchange, Sort)):
+        if isinstance(node, (Project, Exchange, Sort, Window)):
             return self.est_rows(node.child)
         if isinstance(node, Aggregate):
             return max(1.0, 0.1 * self.est_rows(node.child))
@@ -157,6 +157,31 @@ class _Distributor:
             local = Limit(child, node.count)
             exch = Exchange(local, "gather")
             return Limit(exch, node.count), _Part("replicated")
+
+        if isinstance(node, Window):
+            child, part = self.visit(node.child)
+            if part.kind == "replicated":
+                return (
+                    Window(child, node.partition_by, node.order_by, node.calls, node.call_names),
+                    part,
+                )
+            if node.partition_by:
+                already = part.kind == "hash" and all(
+                    any(k == p for p in node.partition_by) for k in part.keys
+                )
+                if not already:
+                    child = Exchange(child, "repartition", node.partition_by)
+                    part = _Part("hash", node.partition_by)
+                return (
+                    Window(child, node.partition_by, node.order_by, node.calls, node.call_names),
+                    part,
+                )
+            # no PARTITION BY: the whole relation is one window partition
+            child = Exchange(child, "gather")
+            return (
+                Window(child, node.partition_by, node.order_by, node.calls, node.call_names),
+                _Part("replicated"),
+            )
 
         raise NotImplementedError(f"distribute: {type(node).__name__}")
 

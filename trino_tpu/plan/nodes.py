@@ -250,6 +250,46 @@ class Distinct(PlanNode):
 
 
 @dataclass(frozen=True)
+class WindowCall:
+    """One window function evaluation.
+    fn: row_number | rank | dense_rank | ntile is NOT supported yet |
+        sum | count | count_star | avg | min | max |
+        lag | lead | first_value | last_value
+    frame: 'range' (default with ORDER BY: peers included) | 'rows' |
+           'whole' (full partition; default without ORDER BY)"""
+
+    fn: str
+    args: tuple[IrExpr, ...]
+    type: Type
+    frame: str = "range"
+
+
+@dataclass(frozen=True)
+class Window(PlanNode):
+    """Window function evaluation (reference: WindowNode ->
+    operator/WindowOperator.java + window/ framework).  Output schema =
+    child columns ++ one column per call."""
+
+    child: PlanNode
+    partition_by: tuple[IrExpr, ...]
+    order_by: tuple["SortKey", ...]
+    calls: tuple[WindowCall, ...]
+    call_names: tuple[str, ...]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names + self.call_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types + tuple(c.type for c in self.calls)
+
+
+@dataclass(frozen=True)
 class Exchange(PlanNode):
     """Data redistribution boundary (reference: ExchangeNode inserted by
     AddExchanges.java:143; physically PartitionedOutputOperator -> HTTP ->

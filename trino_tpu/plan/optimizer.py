@@ -19,7 +19,7 @@ from typing import Optional
 from .ir import FieldRef, IrExpr, field_refs, remap
 from .nodes import (
     Aggregate, AggCall, Distinct, Filter, Join, Limit, PlanNode, Project,
-    Sort, SortKey, TableScan, TopN, Values,
+    Sort, SortKey, TableScan, TopN, Values, Window, WindowCall,
 )
 
 __all__ = ["optimize", "prune_columns"]
@@ -157,5 +157,38 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
 
     if isinstance(node, Values):
         return node, {i: i for i in range(len(node.types))}
+
+    if isinstance(node, Window):
+        nc = len(node.child.output_types)
+        keep_calls = sorted(i for i in range(len(node.calls)) if (nc + i) in needed)
+        child_needed = {i for i in needed if i < nc}
+        for k in node.partition_by:
+            child_needed |= field_refs(k)
+        for k in node.order_by:
+            child_needed |= field_refs(k.expr)
+        for i in keep_calls:
+            for a in node.calls[i].args:
+                child_needed |= field_refs(a)
+        child, m = _prune(node.child, child_needed)
+        new_nc = len(child.output_types)
+        new = Window(
+            child,
+            tuple(remap(k, m) for k in node.partition_by),
+            tuple(SortKey(remap(k.expr, m), k.ascending, k.nulls_first) for k in node.order_by),
+            tuple(
+                WindowCall(
+                    node.calls[i].fn,
+                    tuple(remap(a, m) for a in node.calls[i].args),
+                    node.calls[i].type,
+                    node.calls[i].frame,
+                )
+                for i in keep_calls
+            ),
+            tuple(node.call_names[i] for i in keep_calls),
+        )
+        mapping = dict(m)
+        for pos, i in enumerate(keep_calls):
+            mapping[nc + i] = new_nc + pos
+        return new, mapping
 
     raise NotImplementedError(f"prune: {type(node).__name__}")

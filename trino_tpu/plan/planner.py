@@ -162,7 +162,17 @@ class Planner:
                 raise PlanningError("HAVING without aggregation")
             translator = _Translator(rel.scope, outer)
 
-        # 3. SELECT projection
+        # 3. window functions (evaluate after WHERE/GROUP BY/HAVING,
+        #    before the final projection — SQL evaluation order)
+        win_funcs = self._collect_windows(sel, order_by)
+        if win_funcs:
+            was_grouped = translator.grouped
+            rel, win_map = self._plan_windows(rel, win_funcs, translator, outer)
+            merged = dict(translator.agg_map or {})
+            merged.update(win_map)
+            translator = _Translator(rel.scope, outer, agg_map=merged, grouped=was_grouped)
+
+        # 4. SELECT projection
         items = self._expand_stars(sel.items, rel)
         exprs: list[IrExpr] = []
         names: list[str] = []
@@ -479,6 +489,85 @@ class Planner:
             agg_map[fc] = FieldRef(base + i, aggs[i].type)
         return RelationPlan(node, fields), agg_map
 
+    # --------------------------------------------------------------- windows
+    def _collect_windows(self, sel: A.Select, order_by) -> list[A.WindowFunc]:
+        found: list[A.WindowFunc] = []
+
+        def visit(e: A.Expr):
+            if isinstance(e, A.WindowFunc):
+                if e not in found:
+                    found.append(e)
+                return
+            for c in _ast_children(e):
+                visit(c)
+
+        for it in sel.items:
+            if isinstance(it, A.SelectItem):
+                visit(it.expr)
+        for si in order_by:
+            visit(si.expr)
+        return found
+
+    def _plan_windows(
+        self,
+        rel: RelationPlan,
+        win_funcs: list[A.WindowFunc],
+        translator: "_Translator",
+        outer: Optional[Scope],
+    ) -> tuple[RelationPlan, dict[A.Expr, FieldRef]]:
+        from .nodes import Window, WindowCall
+
+        # one Window node per distinct (partition_by, order_by) spec
+        groups: dict[tuple, list[A.WindowFunc]] = {}
+        for wf in win_funcs:
+            key = (wf.partition_by, wf.order_by)
+            groups.setdefault(key, []).append(wf)
+
+        win_map: dict[A.Expr, FieldRef] = {}
+        for (partition_by, w_order_by), funcs in groups.items():
+            t = _Translator(
+                rel.scope, outer, agg_map=translator.agg_map, grouped=translator.grouped
+            )
+            part_irs = tuple(t.translate(p) for p in partition_by)
+            keys = tuple(
+                SortKey(t.translate(si.expr), si.ascending, _nulls_first(si))
+                for si in w_order_by
+            )
+            calls: list[WindowCall] = []
+            base = len(rel.fields)
+            for wf in funcs:
+                frame = wf.frame
+                if frame in ("rows_unbounded", "groups_unbounded"):
+                    frame = "rows"
+                elif frame == "range_unbounded":
+                    frame = "range"
+                elif frame is None:
+                    frame = "range" if w_order_by else "whole"
+                fn = wf.name
+                args = tuple(t.translate(a) for a in wf.args)
+                if fn in ("row_number", "rank", "dense_rank"):
+                    out_t = BIGINT
+                elif fn == "count":
+                    out_t = BIGINT
+                    if not args:
+                        fn = "count_star"
+                elif fn == "avg":
+                    out_t = DOUBLE
+                elif fn == "sum":
+                    out_t = _agg_type("sum", args[0].type)
+                elif fn in ("min", "max", "lag", "lead", "first_value", "last_value"):
+                    out_t = args[0].type
+                else:
+                    raise PlanningError(f"unknown window function: {fn}")
+                calls.append(WindowCall(fn, args, out_t, frame))
+            names = tuple(f"_w{base + i}" for i in range(len(calls)))
+            node = Window(rel.node, part_irs, keys, tuple(calls), names)
+            new_fields = rel.fields + [Field(None, None, c.type) for c in calls]
+            for i, wf in enumerate(funcs):
+                win_map[wf] = FieldRef(base + i, calls[i].type)
+            rel = RelationPlan(node, new_fields)
+        return rel, win_map
+
     # ------------------------------------------------------------- subqueries
     def _apply_boolean(
         self,
@@ -745,10 +834,14 @@ class _Translator:
         scope: Scope,
         outer: Optional[Scope] = None,
         agg_map: Optional[dict[A.Expr, FieldRef]] = None,
+        grouped: Optional[bool] = None,
     ):
         self.scope = scope
         self.outer = outer
         self.agg_map = agg_map
+        # grouped: bare columns must resolve through the agg_map (GROUP BY
+        # context).  A window substitution map alone does not imply grouping.
+        self.grouped = grouped if grouped is not None else (agg_map is not None)
 
     def translate(self, e: A.Expr) -> IrExpr:
         if self.agg_map is not None and e in self.agg_map:
@@ -760,7 +853,7 @@ class _Translator:
             depth, idx, t = hit
             if depth != 0:
                 raise PlanningError(f"unexpected correlated reference: {e}")
-            if self.agg_map is not None:
+            if self.grouped:
                 raise PlanningError(f"column {e} must appear in GROUP BY")
             return FieldRef(idx, t)
         if isinstance(e, A.IntLit):
@@ -1060,6 +1153,12 @@ def _ast_children(e: A.Expr) -> list[A.Expr]:
         return [e.operand]
     if isinstance(e, A.InSubquery):
         return [e.operand]
+    if isinstance(e, A.WindowFunc):
+        return (
+            list(e.args)
+            + list(e.partition_by)
+            + [si.expr for si in e.order_by]
+        )
     return []
 
 

@@ -94,6 +94,19 @@ class FuncCall(Expr):
 
 
 @dataclass(frozen=True)
+class WindowFunc(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame]).
+    frame: None == dialect default (RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    with ORDER BY, whole partition without)."""
+
+    name: str
+    args: tuple[Expr, ...]
+    partition_by: tuple[Expr, ...]
+    order_by: tuple["SortItem", ...]
+    frame: Optional[str] = None  # 'rows_unbounded' | 'range_unbounded' | 'whole'
+
+
+@dataclass(frozen=True)
 class CaseExpr(Expr):
     whens: tuple[tuple[Expr, Expr], ...]  # (condition, result)
     default: Optional[Expr]  # ELSE

@@ -437,20 +437,79 @@ class _Parser:
                 if name == "count" and self.peek_op("*"):
                     self.i += 1
                     self.expect_op(")")
-                    return FuncCall("count", ())
-                distinct = bool(self.accept_kw("DISTINCT"))
-                args: list[Expr] = []
-                if not self.peek_op(")"):
-                    args.append(self.parse_expr())
-                    while self.accept_op(","):
+                    fc: Expr = FuncCall("count", ())
+                else:
+                    distinct = bool(self.accept_kw("DISTINCT"))
+                    args: list[Expr] = []
+                    if not self.peek_op(")"):
                         args.append(self.parse_expr())
-                self.expect_op(")")
-                return FuncCall(name, tuple(args), distinct)
+                        while self.accept_op(","):
+                            args.append(self.parse_expr())
+                    self.expect_op(")")
+                    fc = FuncCall(name, tuple(args), distinct)
+                if self.peek_kw("OVER"):
+                    return self.parse_over(fc)
+                return fc
             parts = [self.ident()]
             while self.accept_op("."):
                 parts.append(self.ident())
             return Ident(tuple(parts))
         raise SqlSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_over(self, fc: FuncCall) -> Expr:
+        from .ast import WindowFunc
+
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition_by: list[Expr] = []
+        order_by: list[SortItem] = []
+        frame = None
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("DESC"):
+                    asc = False
+                else:
+                    self.accept_kw("ASC")
+                nf = None
+                if self.accept_kw("NULLS"):
+                    nf = bool(self.accept_kw("FIRST"))
+                    if not nf:
+                        self.expect_kw("LAST")
+                order_by.append(SortItem(e, asc, nf))
+                if not self.accept_op(","):
+                    break
+        if self.peek_kw("ROWS", "RANGE", "GROUPS"):
+            unit = self.ident().lower()
+            # accept the common frames; semantics beyond the defaults:
+            # ROWS UNBOUNDED PRECEDING [AND CURRENT ROW] and the full-partition
+            # frame UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING
+            if self.accept_kw("BETWEEN"):
+                self.expect_kw("UNBOUNDED")
+                self.expect_kw("PRECEDING")
+                self.expect_kw("AND")
+                if self.accept_kw("UNBOUNDED"):
+                    self.expect_kw("FOLLOWING")
+                    frame = "whole"
+                else:
+                    self.expect_kw("CURRENT")
+                    self.expect_kw("ROW")
+                    frame = f"{unit}_unbounded"
+            else:
+                self.expect_kw("UNBOUNDED")
+                self.expect_kw("PRECEDING")
+                frame = f"{unit}_unbounded"
+        self.expect_op(")")
+        return WindowFunc(
+            fc.name, fc.args, tuple(partition_by), tuple(order_by), frame
+        )
 
     def parse_case(self) -> Expr:
         self.expect_kw("CASE")
