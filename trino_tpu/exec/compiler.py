@@ -34,8 +34,8 @@ from ..ops.relops import (
     limit_mask, sort_rows, top_n,
 )
 from ..plan.nodes import (
-    Aggregate, Distinct, Exchange, Filter, Join, Limit, PlanNode, Project,
-    Sort, TableScan, TopN, Values, Window,
+    Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit, PlanNode,
+    Project, Sort, TableScan, TopN, Values, Window,
 )
 
 __all__ = ["LocalExecutor"]
@@ -299,6 +299,15 @@ def _trace_plan(
             s = emit(node.child)
             return _Stage(s.cols, limit_mask(s.live, node.count))
 
+        if isinstance(node, Concat):
+            stages = [emit(c) for c in node.inputs]
+            cols: list[ColumnVal] = []
+            for ci, t in enumerate(node.output_types):
+                parts = [st.cols[ci] for st in stages]
+                cols.append(_concat_columns(parts, t))
+            live = jnp.concatenate([st.live for st in stages])
+            return _Stage(cols, live)
+
         if isinstance(node, Window):
             from ..ops.window import window_eval
 
@@ -317,6 +326,12 @@ def _trace_plan(
 
         if isinstance(node, Exchange):
             s = emit(node.child)
+            if node.kind == "single":
+                # replicated input that must count once: keep device 0's copy
+                if axis is not None:
+                    on_first = jax.lax.axis_index(axis) == 0
+                    return _Stage(s.cols, s.live & on_first)
+                return s
             if node.kind in ("gather", "broadcast"):
                 from ..parallel.exchange import gather_all
 
@@ -360,6 +375,40 @@ def _trace_plan(
 
 def _none_if_all(valid):
     return valid
+
+
+def _concat_columns(parts: list[ColumnVal], t) -> ColumnVal:
+    """Row-concatenate column fragments; varchar fragments are re-coded into
+    a merged dictionary (host-side, trace time)."""
+    from ..data.page import Dictionary
+
+    dicts = [p.dict for p in parts]
+    if any(d is not None for d in dicts):
+        all_values = np.concatenate([d.values for d in dicts])
+        uniq = np.unique(all_values)
+        merged = Dictionary(uniq)
+        datas = []
+        for p in parts:
+            remap = np.asarray(
+                [merged.code_of(v) for v in p.dict.values], dtype=np.int32
+            )
+            datas.append(jnp.take(jnp.asarray(remap), p.data))
+        data = jnp.concatenate(datas)
+        out_dict = merged
+    else:
+        dtype = jnp.dtype(t.np_dtype)
+        data = jnp.concatenate([p.data.astype(dtype) for p in parts])
+        out_dict = None
+    if all(p.valid is None for p in parts):
+        valid = None
+    else:
+        valid = jnp.concatenate(
+            [
+                p.valid if p.valid is not None else jnp.ones(p.data.shape, jnp.bool_)
+                for p in parts
+            ]
+        )
+    return ColumnVal(data, valid, out_dict, t)
 
 
 def _align_join_keys(lkeys: list[ColumnVal], rkeys: list[ColumnVal]):

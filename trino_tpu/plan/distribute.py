@@ -28,8 +28,8 @@ from ..connectors.spi import CatalogManager
 from ..data.types import BIGINT, DOUBLE
 from .ir import Call, Const, FieldRef, IrExpr
 from .nodes import (
-    AggCall, Aggregate, Distinct, Exchange, Filter, Join, Limit, PlanNode,
-    Project, Sort, TableScan, TopN, Values, Window,
+    AggCall, Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit,
+    PlanNode, Project, Sort, TableScan, TopN, Values, Window,
 )
 
 __all__ = ["distribute"]
@@ -98,6 +98,8 @@ class _Distributor:
             return float(min(node.count, int(self.est_rows(node.child))))
         if isinstance(node, Values):
             return float(len(node.rows))
+        if isinstance(node, Concat):
+            return sum(self.est_rows(c) for c in node.inputs)
         return 1_000_000.0
 
     # --------------------------------------------------------------- visitor
@@ -157,6 +159,15 @@ class _Distributor:
             local = Limit(child, node.count)
             exch = Exchange(local, "gather")
             return Limit(exch, node.count), _Part("replicated")
+
+        if isinstance(node, Concat):
+            new_inputs = []
+            for c in node.inputs:
+                cc, cpart = self.visit(c)
+                if cpart.kind == "replicated":
+                    cc = Exchange(cc, "single")  # count replicated rows once
+                new_inputs.append(cc)
+            return Concat(tuple(new_inputs)), _Part("any")
 
         if isinstance(node, Window):
             child, part = self.visit(node.child)

@@ -250,6 +250,26 @@ class Distinct(PlanNode):
 
 
 @dataclass(frozen=True)
+class Concat(PlanNode):
+    """Row-wise union of same-schema inputs (reference: UNION ALL's
+    concatenating exchange / SetOperationNode lowering)."""
+
+    inputs: tuple[PlanNode, ...]
+
+    @property
+    def children(self):
+        return self.inputs
+
+    @property
+    def output_names(self):
+        return self.inputs[0].output_names
+
+    @property
+    def output_types(self):
+        return self.inputs[0].output_types
+
+
+@dataclass(frozen=True)
 class WindowCall:
     """One window function evaluation.
     fn: row_number | rank | dense_rank | ntile is NOT supported yet |

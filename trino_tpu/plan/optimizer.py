@@ -18,8 +18,8 @@ from typing import Optional
 
 from .ir import FieldRef, IrExpr, field_refs, remap
 from .nodes import (
-    Aggregate, AggCall, Distinct, Filter, Join, Limit, PlanNode, Project,
-    Sort, SortKey, TableScan, TopN, Values, Window, WindowCall,
+    Aggregate, AggCall, Concat, Distinct, Filter, Join, Limit, PlanNode,
+    Project, Sort, SortKey, TableScan, TopN, Values, Window, WindowCall,
 )
 
 __all__ = ["optimize", "prune_columns"]
@@ -157,6 +157,20 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
 
     if isinstance(node, Values):
         return node, {i: i for i in range(len(node.types))}
+
+    if isinstance(node, Concat):
+        keep = sorted(needed) if needed else [0]
+        new_inputs = []
+        for c in node.inputs:
+            pc, m = _prune(c, set(keep))
+            # normalize each input to exactly [keep] in order so rows align
+            exprs = tuple(
+                FieldRef(m[i], node.output_types[i]) for i in keep
+            )
+            names = tuple(node.output_names[i] for i in keep)
+            new_inputs.append(Project(pc, exprs, names))
+        mapping = {old: pos for pos, old in enumerate(keep)}
+        return Concat(tuple(new_inputs)), mapping
 
     if isinstance(node, Window):
         nc = len(node.child.output_types)

@@ -132,8 +132,80 @@ class Planner:
             ctes = dict(ctes)
             for name, cq in q.ctes:
                 ctes[name] = cq
-        rel = self._plan_select(q.select, outer, ctes, order_by=q.order_by, limit=q.limit)
+        rel = self._plan_body(q.select, outer, ctes, order_by=q.order_by, limit=q.limit)
         return rel.node
+
+    def _plan_body(
+        self,
+        body,
+        outer: Optional[Scope],
+        ctes: dict[str, A.Query],
+        order_by: tuple[A.SortItem, ...] = (),
+        limit: Optional[int] = None,
+    ) -> RelationPlan:
+        if isinstance(body, A.SetOp):
+            rel = self._plan_setop(body, outer, ctes)
+            node = rel.node
+            if order_by:
+                keys = []
+                for si in order_by:
+                    keys.append(
+                        SortKey(
+                            self._setop_order_key(si.expr, rel),
+                            si.ascending,
+                            _nulls_first(si),
+                        )
+                    )
+                if limit is not None:
+                    node = TopN(node, tuple(keys), limit)
+                else:
+                    node = Sort(node, tuple(keys))
+            elif limit is not None:
+                node = Limit(node, limit)
+            return RelationPlan(node, rel.fields)
+        return self._plan_select(body, outer, ctes, order_by=order_by, limit=limit)
+
+    def _setop_order_key(self, e: A.Expr, rel: RelationPlan) -> IrExpr:
+        if isinstance(e, A.IntLit):
+            if not (1 <= e.value <= len(rel.fields)):
+                raise PlanningError(f"ORDER BY position {e.value} out of range")
+            return FieldRef(e.value - 1, rel.fields[e.value - 1].type)
+        if isinstance(e, A.Ident) and len(e.parts) == 1:
+            for i, f in enumerate(rel.fields):
+                if f.name == e.parts[0]:
+                    return FieldRef(i, f.type)
+        raise PlanningError(f"ORDER BY over a set operation must reference output columns: {e}")
+
+    def _plan_setop(
+        self, s: A.SetOp, outer: Optional[Scope], ctes: dict[str, A.Query]
+    ) -> RelationPlan:
+        from .nodes import Concat
+
+        left = self._plan_body(s.left, outer, ctes)
+        right = self._plan_body(s.right, outer, ctes)
+        if len(left.fields) != len(right.fields):
+            raise PlanningError(
+                f"set operation arity mismatch: {len(left.fields)} vs {len(right.fields)}"
+            )
+        types = [
+            common_super_type(l.type, r.type)
+            for l, r in zip(left.fields, right.fields)
+        ]
+        left = _cast_relation(left, types)
+        right = _cast_relation(right, types)
+        fields = [Field(None, f.name, t) for f, t in zip(left.fields, types)]
+        if s.kind == "union":
+            rel = RelationPlan(Concat((left.node, right.node)), fields)
+            if not s.all:
+                rel = RelationPlan(Distinct(rel.node), fields)
+            return rel
+        if s.all:
+            raise PlanningError(f"{s.kind.upper()} ALL not supported")
+        keys_l = tuple(FieldRef(i, t) for i, t in enumerate(types))
+        keys_r = tuple(FieldRef(i, t) for i, t in enumerate(types))
+        kind = "semi" if s.kind == "intersect" else "anti"
+        join = Join(kind, left.node, right.node, keys_l, keys_r, None)
+        return RelationPlan(Distinct(join), fields)
 
     # ----------------------------------------------------------------- select
     def _plan_select(
@@ -423,7 +495,7 @@ class Planner:
             ctes = dict(ctes)
             for name, cq in q.ctes:
                 ctes[name] = cq
-        return self._plan_select(q.select, outer, ctes, order_by=q.order_by, limit=q.limit)
+        return self._plan_body(q.select, outer, ctes, order_by=q.order_by, limit=q.limit)
 
     # ----------------------------------------------------------- aggregation
     def _collect_aggs(self, sel: A.Select, order_by) -> list[A.FuncCall]:
@@ -624,6 +696,8 @@ class Planner:
         self, q: A.Query, outer_scope: Scope, ctes: dict[str, A.Query]
     ) -> tuple[RelationPlan, list[A.Expr]]:
         """Plan the subquery FROM + local WHERE; return correlated conjuncts."""
+        if isinstance(q.select, A.SetOp):
+            raise PlanningError("correlated set-operation subqueries not supported")
         sel = q.select
         if q.ctes:
             ctes = dict(ctes)
@@ -653,6 +727,8 @@ class Planner:
         outer: Optional[Scope],
         ctes: dict[str, A.Query],
     ) -> RelationPlan:
+        if isinstance(q.select, A.SetOp):
+            raise PlanningError("EXISTS over a set operation not supported")
         if q.select.group_by or self._collect_aggs(q.select, ()):
             raise PlanningError("EXISTS with aggregation not supported")
         outer_scope = Scope(rel.fields, outer)
@@ -739,6 +815,8 @@ class Planner:
         ctes: dict[str, A.Query],
         translator: "_Translator",
     ) -> RelationPlan:
+        if isinstance(q.select, A.SetOp):
+            raise PlanningError("scalar subquery over a set operation not supported")
         sel = q.select
         outer_scope = Scope(rel.fields, outer)
         inner, correlated = self._split_correlated(q, outer_scope, ctes)
@@ -1068,6 +1146,19 @@ def _days_in_month(y: int, m: int) -> int:
     import calendar
 
     return calendar.monthrange(y, m)[1]
+
+
+def _cast_relation(rel: RelationPlan, types: list[Type]) -> RelationPlan:
+    """Wrap a Project applying columnwise casts when needed."""
+    if all(f.type == t for f, t in zip(rel.fields, types)):
+        return rel
+    exprs = tuple(
+        _cast_ir(FieldRef(i, f.type), t)
+        for i, (f, t) in enumerate(zip(rel.fields, types))
+    )
+    names = tuple(f.name or f"_c{i}" for i, f in enumerate(rel.fields))
+    node = Project(rel.node, exprs, names)
+    return RelationPlan(node, [Field(f.qualifier, f.name, t) for f, t in zip(rel.fields, types)])
 
 
 def _as_bool(e: IrExpr) -> IrExpr:

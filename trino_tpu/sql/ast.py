@@ -227,10 +227,20 @@ class Select:
 
 
 @dataclass(frozen=True)
-class Query:
-    """A full query expression: SELECT body + ORDER BY/LIMIT + optional WITH."""
+class SetOp:
+    """UNION / INTERSECT / EXCEPT.  Operands are Select or SetOp."""
 
-    select: Select
+    kind: str  # union | intersect | except
+    all: bool  # ALL vs DISTINCT semantics
+    left: "Select | SetOp"
+    right: "Select | SetOp"
+
+
+@dataclass(frozen=True)
+class Query:
+    """A full query expression: body + ORDER BY/LIMIT + optional WITH."""
+
+    select: "Select | SetOp"
     order_by: tuple[SortItem, ...] = ()
     limit: Optional[int] = None
     ctes: tuple[tuple[str, "Query"], ...] = field(default=())

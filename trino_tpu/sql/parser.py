@@ -107,7 +107,7 @@ class _Parser:
                 ctes.append((name, q))
                 if not self.accept_op(","):
                     break
-        select = self.parse_select()
+        select = self.parse_set_expr()
         order_by: list[SortItem] = []
         if self.accept_kw("ORDER"):
             self.expect_kw("BY")
@@ -134,6 +134,40 @@ class _Parser:
             limit = int(t.value)
             self.i += 1
         return Query(select, tuple(order_by), limit, tuple(ctes))
+
+    def parse_set_expr(self):
+        """UNION/EXCEPT (left-assoc) over INTERSECT (binds tighter)."""
+        from .ast import SetOp
+
+        left = self.parse_intersect_expr()
+        while True:
+            kw = self.accept_kw("UNION", "EXCEPT")
+            if kw is None:
+                return left
+            all_ = bool(self.accept_kw("ALL"))
+            if not all_:
+                self.accept_kw("DISTINCT")
+            right = self.parse_intersect_expr()
+            left = SetOp(kw.lower(), all_, left, right)
+
+    def parse_intersect_expr(self):
+        from .ast import SetOp
+
+        left = self.parse_set_primary()
+        while self.accept_kw("INTERSECT"):
+            all_ = bool(self.accept_kw("ALL"))
+            if not all_:
+                self.accept_kw("DISTINCT")
+            right = self.parse_set_primary()
+            left = SetOp("intersect", all_, left, right)
+        return left
+
+    def parse_set_primary(self):
+        if self.accept_op("("):
+            q = self.parse_set_expr()
+            self.expect_op(")")
+            return q
+        return self.parse_select()
 
     def parse_select(self) -> Select:
         self.expect_kw("SELECT")
