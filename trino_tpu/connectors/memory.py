@@ -1,0 +1,116 @@
+"""In-memory writable connector (reference: plugin/trino-memory — the test
+fixture connector) and the /dev/null blackhole connector (reference:
+plugin/trino-blackhole — write benchmarks, scheduling tests)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..data.types import Type
+from .spi import ColumnSchema, Connector, Split, TableSchema
+
+__all__ = ["MemoryConnector", "BlackholeConnector"]
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self) -> None:
+        self._tables: dict[str, TableSchema] = {}
+        self._data: dict[str, dict[str, np.ndarray]] = {}
+        self.generation = 0  # bumped on every write; invalidates scan caches
+
+    # ---- metadata ----------------------------------------------------------
+    def list_tables(self) -> list[str]:
+        return sorted(self._tables)
+
+    def table_schema(self, table: str) -> TableSchema:
+        if table not in self._tables:
+            raise KeyError(f"memory table not found: {table}")
+        return self._tables[table]
+
+    def create_table(self, name: str, columns: Sequence[ColumnSchema]) -> None:
+        if name in self._tables:
+            raise ValueError(f"table already exists: {name}")
+        self._tables[name] = TableSchema(name, tuple(columns))
+        self._data[name] = {
+            c.name: np.empty((0,), dtype=object if c.type.is_string else c.type.np_dtype)
+            for c in columns
+        }
+        self.generation += 1
+
+    def drop_table(self, name: str) -> None:
+        self._tables.pop(name)
+        self._data.pop(name)
+        self.generation += 1
+
+    # ---- reads -------------------------------------------------------------
+    def get_splits(self, table: str, desired_parts: int) -> list[Split]:
+        return [Split("memory", table, p, desired_parts) for p in range(desired_parts)]
+
+    def read_split(self, split: Split, columns: Sequence[str]) -> dict[str, np.ndarray]:
+        data = self._data[split.table]
+        n = len(next(iter(data.values()))) if data else 0
+        lo = split.part * n // split.num_parts
+        hi = (split.part + 1) * n // split.num_parts
+        return {c: data[c][lo:hi] for c in columns}
+
+    # ---- writes (reference: ConnectorPageSink) ------------------------------
+    def insert(self, table: str, columns: dict[str, np.ndarray]) -> int:
+        schema = self.table_schema(table)
+        data = self._data[table]
+        n = len(next(iter(columns.values()))) if columns else 0
+        for c in schema.columns:
+            arr = columns[c.name]
+            data[c.name] = np.concatenate([data[c.name], arr])
+        self.generation += 1
+        return n
+
+    def estimated_row_count(self, table: str) -> Optional[int]:
+        data = self._data.get(table)
+        if not data:
+            return 0
+        return len(next(iter(data.values())))
+
+
+class BlackholeConnector(Connector):
+    """Accepts any write, returns empty scans — sink for write benchmarks."""
+
+    name = "blackhole"
+
+    def __init__(self) -> None:
+        self._tables: dict[str, TableSchema] = {}
+        self.rows_swallowed = 0
+        self.generation = 0
+
+    def list_tables(self) -> list[str]:
+        return sorted(self._tables)
+
+    def table_schema(self, table: str) -> TableSchema:
+        return self._tables[table]
+
+    def create_table(self, name: str, columns: Sequence[ColumnSchema]) -> None:
+        self._tables[name] = TableSchema(name, tuple(columns))
+
+    def drop_table(self, name: str) -> None:
+        self._tables.pop(name)
+
+    def get_splits(self, table: str, desired_parts: int) -> list[Split]:
+        return [Split("blackhole", table, 0, 1)]
+
+    def read_split(self, split: Split, columns: Sequence[str]) -> dict[str, np.ndarray]:
+        schema = self.table_schema(split.table)
+        return {
+            c: np.empty((0,), dtype=object if schema.type_of(c).is_string else schema.type_of(c).np_dtype)
+            for c in columns
+        }
+
+    def insert(self, table: str, columns: dict[str, np.ndarray]) -> int:
+        n = len(next(iter(columns.values()))) if columns else 0
+        self.rows_swallowed += n
+        return n
+
+    def estimated_row_count(self, table: str) -> Optional[int]:
+        return 0

@@ -190,6 +190,24 @@ class Page:
             )
         return rows
 
+    def to_numpy_columns(self) -> list[np.ndarray]:
+        """Compact live rows to host column arrays (connector write path:
+        VARCHAR decodes to object strings, DATE stays as day counts)."""
+        live = np.asarray(self.live_mask())
+        idx = np.nonzero(live)[0]
+        out: list[np.ndarray] = []
+        for col in self.columns:
+            data = np.asarray(col.data)[idx]
+            if col.type.is_string:
+                if len(idx):
+                    data = col.dictionary.values[
+                        np.clip(data, 0, max(len(col.dictionary) - 1, 0))
+                    ]
+                else:
+                    data = np.array([], dtype=object)
+            out.append(data)
+        return out
+
     @staticmethod
     def from_numpy(types: Sequence[Type], arrays: Sequence[np.ndarray]) -> "Page":
         assert len(types) == len(arrays)

@@ -90,7 +90,9 @@ class LocalExecutor:
         reference does via ConnectorPageSource lazy blocks)."""
         conn = self.catalogs.get(catalog)
         schema = conn.table_schema(table)
-        missing = [c for c in columns if (catalog, table, c) not in self._table_cols]
+        gen = getattr(conn, "generation", 0)  # writable connectors bump this
+        key_of = lambda c: (catalog, table, c, gen)
+        missing = [c for c in columns if key_of(c) not in self._table_cols]
         if missing:
             splits = conn.get_splits(table, 1)
             data = conn.read_split(splits[0], missing)
@@ -98,10 +100,10 @@ class LocalExecutor:
                 more = conn.read_split(s, missing)
                 data = {c: np.concatenate([data[c], more[c]]) for c in missing}
             for c in missing:
-                self._table_cols[(catalog, table, c)] = Column.from_numpy(
+                self._table_cols[key_of(c)] = Column.from_numpy(
                     schema.type_of(c), data[c]
                 )
-        return Page(tuple(self._table_cols[(catalog, table, c)] for c in columns))
+        return Page(tuple(self._table_cols[key_of(c)] for c in columns))
 
     # ------------------------------------------------------------ execution
     def execute(self, plan: PlanNode) -> Page:

@@ -46,11 +46,16 @@ class _Part:
     keys: tuple[IrExpr, ...] = ()
 
 
-def distribute(plan: PlanNode, catalogs: CatalogManager, num_devices: int) -> PlanNode:
+def distribute(
+    plan: PlanNode,
+    catalogs: CatalogManager,
+    num_devices: int,
+    session=None,
+) -> PlanNode:
     """Rewrite a single-node plan into an SPMD plan for `num_devices`."""
     if num_devices <= 1:
         return plan
-    d = _Distributor(catalogs)
+    d = _Distributor(catalogs, session)
     node, part = d.visit(plan)
     if part.kind != "replicated":
         node = Exchange(node, "gather")
@@ -71,8 +76,19 @@ def _re_finalize(node: PlanNode, original: PlanNode) -> PlanNode:
 
 
 class _Distributor:
-    def __init__(self, catalogs: CatalogManager):
+    def __init__(self, catalogs: CatalogManager, session=None):
         self.catalogs = catalogs
+        self.session = session
+
+    def _join_mode(self) -> str:
+        if self.session is None:
+            return "AUTOMATIC"
+        return self.session.get("join_distribution_type")
+
+    def _broadcast_limit(self) -> int:
+        if self.session is None:
+            return _BROADCAST_LIMIT
+        return self.session.get("broadcast_join_row_limit")
 
     # ------------------------------------------------------------ size model
     def est_rows(self, node: PlanNode) -> float:
@@ -321,8 +337,10 @@ class _Distributor:
 
         est_right = self.est_rows(node.right)
         varchar_keys = any(k.type.is_string for k in node.left_keys)
+        mode = self._join_mode()
         broadcast = (
-            est_right <= _BROADCAST_LIMIT
+            (mode == "BROADCAST")
+            or (mode == "AUTOMATIC" and est_right <= self._broadcast_limit())
             or varchar_keys
             or not node.left_keys
             or rpart.kind == "replicated"

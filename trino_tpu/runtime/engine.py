@@ -3,7 +3,9 @@
 The single-process counterpart of the reference's coordinator pipeline
 (dispatcher/DispatchManager.createQuery -> SqlQueryExecution.start ->
 LogicalPlanner -> scheduler -> operators), collapsed to:
-parse -> plan (planner.py) -> compile+execute (exec/compiler.py).
+parse -> plan (planner.py) -> compile+execute (exec/compiler.py), plus the
+statement surface (DDL/DML/EXPLAIN/SHOW/SET SESSION — the reference's
+DataDefinitionTask family and writer plans).
 
 The reference's closest analogue is PlanTester/StandaloneQueryRunner
 (testing/PlanTester.java:274): the full engine in-process without HTTP.
@@ -11,13 +13,17 @@ The reference's closest analogue is PlanTester/StandaloneQueryRunner
 
 from __future__ import annotations
 
+import time as _time
 from typing import Optional
 
-from ..connectors.spi import CatalogManager, Connector
+import numpy as np
+
+from ..connectors.spi import CatalogManager, ColumnSchema, Connector
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
 from ..plan.nodes import PlanNode, format_plan
 from ..plan.planner import Planner
+from .session import SessionProperties
 
 __all__ = ["Engine"]
 
@@ -43,26 +49,159 @@ class Engine:
         else:
             self.executor = LocalExecutor(self.catalogs, default_catalog)
         self.distributed = distributed
+        self.session = SessionProperties()
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
 
-    def plan(self, sql: str) -> PlanNode:
+    # ------------------------------------------------------------- queries
+    def plan(self, sql_or_query) -> PlanNode:
         from ..plan.optimizer import optimize
 
-        plan = optimize(self.planner.plan(sql))
+        plan = optimize(self.planner.plan(sql_or_query))
         if self.distributed:
             from ..plan.distribute import distribute
 
-            plan = distribute(plan, self.catalogs, self.executor.num_devices)
+            plan = distribute(
+                plan, self.catalogs, self.executor.num_devices, self.session
+            )
         return plan
 
     def explain(self, sql: str) -> str:
         return format_plan(self.plan(sql))
 
-    def execute_page(self, sql: str) -> Page:
+    def execute_page(self, sql) -> Page:
         return self.executor.execute(self.plan(sql))
 
-    def query(self, sql: str) -> list[tuple]:
+    def query(self, sql) -> list[tuple]:
         """Run a query, return rows as python tuples (None == NULL)."""
         return self.execute_page(sql).to_pylist()
+
+    # ---------------------------------------------------- statement surface
+    def execute(self, sql: str) -> list[tuple]:
+        """Full statement surface: queries, DDL/DML, EXPLAIN [ANALYZE],
+        SHOW TABLES, DESCRIBE, SET SESSION."""
+        from ..sql import statements as S
+
+        stmt = S.parse_statement(sql)
+
+        if isinstance(stmt, S.QueryStmt):
+            return self.query(stmt.query)
+
+        if isinstance(stmt, S.Explain):
+            plan = self.plan(stmt.query)
+            if not stmt.analyze:
+                return [(line,) for line in format_plan(plan).splitlines()]
+            t0 = _time.perf_counter()
+            rows = self.executor.execute(plan).to_pylist()
+            wall = _time.perf_counter() - t0
+            text = format_plan(plan).splitlines()
+            text.append(f"-- output rows: {len(rows)}, wall: {wall * 1000:.1f} ms")
+            return [(line,) for line in text]
+
+        if isinstance(stmt, S.CreateTable):
+            from ..data.types import parse_type
+
+            conn = self.catalogs.get(self.default_catalog)
+            if stmt.if_not_exists and stmt.name in conn.list_tables():
+                return [(0,)]
+            conn.create_table(
+                stmt.name, [ColumnSchema(n, parse_type(t)) for n, t in stmt.columns]
+            )
+            return [(0,)]
+
+        if isinstance(stmt, S.CreateTableAs):
+            conn = self.catalogs.get(self.default_catalog)
+            if stmt.if_not_exists and stmt.name in conn.list_tables():
+                return [(0,)]
+            plan = self.plan(stmt.query)
+            page = self.executor.execute(plan)
+            cols = page.to_numpy_columns()
+            conn.create_table(
+                stmt.name,
+                [ColumnSchema(n, t) for n, t in zip(plan.output_names, plan.output_types)],
+            )
+            n = conn.insert(stmt.name, dict(zip(plan.output_names, cols)))
+            return [(n,)]
+
+        if isinstance(stmt, S.Insert):
+            plan = self.plan(stmt.query)
+            page = self.executor.execute(plan)
+            return [(self._insert(stmt.table, stmt.columns, page),)]
+
+        if isinstance(stmt, S.InsertValues):
+            return [(self._insert_values(stmt),)]
+
+        if isinstance(stmt, S.DropTable):
+            conn = self.catalogs.get(self.default_catalog)
+            if stmt.if_exists and stmt.name not in conn.list_tables():
+                return [(0,)]
+            conn.drop_table(stmt.name)
+            return [(0,)]
+
+        if isinstance(stmt, S.ShowTables):
+            conn = self.catalogs.get(self.default_catalog)
+            return [(t,) for t in conn.list_tables()]
+
+        if isinstance(stmt, S.DescribeTable):
+            conn = self.catalogs.get(self.default_catalog)
+            schema = conn.table_schema(stmt.name)
+            return [(c.name, c.type.name) for c in schema.columns]
+
+        if isinstance(stmt, S.SetSession):
+            self.session.set(stmt.name, stmt.value)
+            return [(1,)]
+
+        raise NotImplementedError(f"statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------ write path
+    def _insert(self, table: str, columns, page: Page) -> int:
+        conn = self.catalogs.get(self.default_catalog)
+        schema = conn.table_schema(table)
+        cols = page.to_numpy_columns()
+        names = list(columns) if columns else [c.name for c in schema.columns]
+        if len(names) != len(cols):
+            raise ValueError(f"INSERT column count mismatch: {len(names)} vs {len(cols)}")
+        data = {}
+        for cname, arr in zip(names, cols):
+            t = schema.type_of(cname)
+            data[cname] = arr if t.is_string else np.asarray(arr).astype(t.np_dtype)
+        n = len(cols[0]) if cols else 0
+        for c in schema.columns:  # unreferenced columns default to zero values
+            if c.name not in data:
+                data[c.name] = np.zeros(
+                    (n,), dtype=object if c.type.is_string else c.type.np_dtype
+                )
+        return conn.insert(table, data)
+
+    def _insert_values(self, stmt) -> int:
+        from ..plan.ir import Const
+        from ..plan.planner import Scope, _Translator
+
+        conn = self.catalogs.get(self.default_catalog)
+        schema = conn.table_schema(stmt.table)
+        names = list(stmt.columns) if stmt.columns else [c.name for c in schema.columns]
+        t = _Translator(Scope([]))
+        rows = []
+        for row in stmt.rows:
+            vals = []
+            for e in row:
+                ir = t.translate(e)
+                if not isinstance(ir, Const):
+                    raise ValueError(f"INSERT VALUES must be literals: {e}")
+                vals.append(ir.value)
+            rows.append(vals)
+        n = len(rows)
+        data = {}
+        for ci, cname in enumerate(names):
+            typ = schema.type_of(cname)
+            col = [r[ci] for r in rows]
+            data[cname] = np.asarray(
+                col, dtype=object if typ.is_string else typ.np_dtype
+            )
+        for c in schema.columns:
+            if c.name not in data:
+                data[c.name] = np.zeros(
+                    (n,), dtype=object if c.type.is_string else c.type.np_dtype
+                )
+        return conn.insert(stmt.table, data)

@@ -1,0 +1,88 @@
+"""Session properties — the runtime flag system.
+
+The reference exposes 157 session properties (SystemSessionProperties.java)
+settable per-query via SET SESSION / wire headers, validated and typed, on
+top of 396 static @Config settings.  This is the same shape: typed,
+validated properties with defaults; engine components read them at plan /
+execution time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+__all__ = ["SessionProperties", "PROPERTIES"]
+
+
+@dataclass(frozen=True)
+class _Prop:
+    name: str
+    type: type
+    default: Any
+    description: str
+    validate: Optional[Callable[[Any], bool]] = None
+
+
+PROPERTIES: dict[str, _Prop] = {
+    p.name: p
+    for p in [
+        _Prop(
+            "join_distribution_type", str, "AUTOMATIC",
+            "AUTOMATIC | PARTITIONED | BROADCAST (reference: "
+            "DetermineJoinDistributionType.java:51)",
+            lambda v: v in ("AUTOMATIC", "PARTITIONED", "BROADCAST"),
+        ),
+        _Prop(
+            "broadcast_join_row_limit", int, 100_000,
+            "estimated build rows at or below which AUTOMATIC picks broadcast",
+            lambda v: v > 0,
+        ),
+        _Prop(
+            "group_by_segment_limit", int, 65536,
+            "initial capacity tier for group-by outputs",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "query_max_run_time_s", float, 3600.0,
+            "wall-clock limit enforced by the query state machine",
+            lambda v: v > 0,
+        ),
+        _Prop(
+            "retry_policy", str, "NONE",
+            "NONE | QUERY — query-level retry on worker failure "
+            "(reference: RetryPolicy)",
+            lambda v: v in ("NONE", "QUERY"),
+        ),
+        _Prop("explain_format", str, "text", "text | json", None),
+    ]
+}
+
+
+class SessionProperties:
+    def __init__(self) -> None:
+        self._values: dict[str, Any] = {}
+
+    def set(self, name: str, raw: str) -> None:
+        if name not in PROPERTIES:
+            raise KeyError(f"unknown session property: {name}")
+        p = PROPERTIES[name]
+        if p.type is int:
+            value: Any = int(raw)
+        elif p.type is float:
+            value = float(raw)
+        elif p.type is bool:
+            value = raw.lower() in ("true", "1", "on")
+        else:
+            value = str(raw)
+        if p.validate is not None and not p.validate(value):
+            raise ValueError(f"invalid value for {name}: {raw!r}")
+        self._values[name] = value
+
+    def get(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        return PROPERTIES[name].default
+
+    def as_dict(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in PROPERTIES}

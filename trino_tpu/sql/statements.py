@@ -1,0 +1,206 @@
+"""Non-query statements: DDL / DML / session / introspection.
+
+The reference parses these into dedicated AST nodes (core/trino-parser:
+CreateTable, CreateTableAsSelect, Insert, DropTable, Explain, ShowTables,
+SetSession...) and routes DataDefinitionTask implementations on the
+coordinator (execution/DataDefinitionExecution.java); queries with writer
+plans get TableWriterOperator/TableFinishOperator.  Here statements are
+parsed by `parse_statement` and dispatched by runtime/engine.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .ast import Expr, Query
+from .lexer import SqlSyntaxError, tokenize
+from .parser import _Parser
+
+__all__ = [
+    "Statement", "QueryStmt", "CreateTable", "CreateTableAs", "Insert",
+    "DropTable", "Explain", "ShowTables", "DescribeTable", "SetSession",
+    "InsertValues", "parse_statement",
+]
+
+
+class Statement:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class QueryStmt(Statement):
+    query: Query
+
+
+@dataclass(frozen=True)
+class CreateTable(Statement):
+    name: str
+    columns: tuple[tuple[str, str], ...]  # (name, type text)
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTableAs(Statement):
+    name: str
+    query: Query
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Insert(Statement):
+    table: str
+    columns: Optional[tuple[str, ...]]
+    query: Query
+
+
+@dataclass(frozen=True)
+class InsertValues(Statement):
+    table: str
+    columns: Optional[tuple[str, ...]]
+    rows: tuple[tuple[Expr, ...], ...]
+
+
+@dataclass(frozen=True)
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Explain(Statement):
+    query: Query
+    analyze: bool = False
+    distributed: bool = False
+
+
+@dataclass(frozen=True)
+class ShowTables(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class DescribeTable(Statement):
+    name: str
+
+
+@dataclass(frozen=True)
+class SetSession(Statement):
+    name: str
+    value: str
+
+
+def parse_statement(sql: str) -> Statement:
+    p = _Parser(tokenize(sql))
+    stmt = _parse_statement(p)
+    p.accept_op(";")
+    p.expect_eof()
+    return stmt
+
+
+def _parse_statement(p: "_Parser") -> Statement:
+    if p.peek_kw("SELECT", "WITH"):
+        return QueryStmt(p.parse_query())
+
+    if p.accept_kw("EXPLAIN"):
+        analyze = bool(p.accept_kw("ANALYZE"))
+        distributed = False
+        if p.accept_op("("):  # EXPLAIN (TYPE DISTRIBUTED)
+            while not p.accept_op(")"):
+                if p.accept_kw("TYPE"):
+                    distributed = bool(p.accept_kw("DISTRIBUTED"))
+                    p.accept_kw("LOGICAL")
+                else:
+                    p.i += 1
+        return Explain(p.parse_query(), analyze, distributed)
+
+    if p.accept_kw("CREATE"):
+        p.expect_kw("TABLE")
+        if_not_exists = False
+        if p.accept_kw("IF"):
+            p.expect_kw("NOT")
+            p.expect_kw("EXISTS")
+            if_not_exists = True
+        name = _table_name(p)
+        if p.accept_kw("AS"):
+            q = p.parse_query()
+            return CreateTableAs(name, q, if_not_exists)
+        p.expect_op("(")
+        cols = []
+        while True:
+            cname = p.ident()
+            ctype = p.parse_type_name()
+            cols.append((cname, ctype))
+            if not p.accept_op(","):
+                break
+        p.expect_op(")")
+        if p.accept_kw("AS"):
+            return CreateTableAs(name, p.parse_query(), if_not_exists)
+        return CreateTable(name, tuple(cols), if_not_exists)
+
+    if p.accept_kw("INSERT"):
+        p.expect_kw("INTO")
+        name = _table_name(p)
+        columns = None
+        if p.peek_op("("):
+            save = p.i
+            p.expect_op("(")
+            try:
+                cols = [p.ident()]
+                while p.accept_op(","):
+                    cols.append(p.ident())
+                p.expect_op(")")
+                columns = tuple(cols)
+            except SqlSyntaxError:
+                p.i = save
+        if p.accept_kw("VALUES"):
+            rows = []
+            while True:
+                p.expect_op("(")
+                row = [p.parse_expr()]
+                while p.accept_op(","):
+                    row.append(p.parse_expr())
+                p.expect_op(")")
+                rows.append(tuple(row))
+                if not p.accept_op(","):
+                    break
+            return InsertValues(name, columns, tuple(rows))
+        return Insert(name, columns, p.parse_query())
+
+    if p.accept_kw("DROP"):
+        p.expect_kw("TABLE")
+        if_exists = False
+        if p.accept_kw("IF"):
+            p.expect_kw("EXISTS")
+            if_exists = True
+        return DropTable(_table_name(p), if_exists)
+
+    if p.accept_kw("SHOW"):
+        p.expect_kw("TABLES")
+        return ShowTables()
+
+    if p.accept_kw("DESCRIBE") or p.accept_kw("DESC"):
+        return DescribeTable(_table_name(p))
+
+    if p.accept_kw("SET"):
+        p.expect_kw("SESSION")
+        key = p.ident()
+        while p.accept_op("."):
+            key += "." + p.ident()
+        p.expect_op("=")
+        t = p.cur
+        if t.kind in ("STRING", "NUMBER"):
+            value = t.value
+            p.i += 1
+        else:
+            value = p.ident()
+        return SetSession(key, value)
+
+    raise SqlSyntaxError(f"unrecognized statement at {p.cur.pos}: {p.cur.value!r}")
+
+
+def _table_name(p: "_Parser") -> str:
+    name = p.ident()
+    while p.accept_op("."):
+        name = p.ident()
+    return name
