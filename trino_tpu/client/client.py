@@ -1,0 +1,49 @@
+"""StatementClient: submit SQL, follow nextUri until results.
+
+Reference: client/trino-client/.../StatementClientV1.java:76 (POST
+/v1/statement at :154, advance() polling nextUri at :391)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Optional
+
+__all__ = ["StatementClient", "QueryFailed"]
+
+
+class QueryFailed(Exception):
+    pass
+
+
+class StatementClient:
+    def __init__(self, server_url: str, poll_interval: float = 0.05):
+        self.server_url = server_url.rstrip("/")
+        self.poll_interval = poll_interval
+
+    def execute(self, sql: str, timeout: float = 600.0) -> tuple[list[str], list[list]]:
+        """-> (column_names, rows)"""
+        req = urllib.request.Request(
+            f"{self.server_url}/v1/statement", data=sql.encode()
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            state = json.loads(r.read())
+        deadline = time.time() + timeout
+        while True:
+            if "data" in state:
+                return state.get("columns", []), state["data"]
+            if state.get("stats", {}).get("state") == "FAILED":
+                raise QueryFailed(state.get("error", "query failed"))
+            next_uri = state.get("nextUri")
+            if next_uri is None:
+                raise QueryFailed(f"no nextUri and no data: {state}")
+            if time.time() > deadline:
+                raise TimeoutError(f"query did not finish in {timeout}s")
+            time.sleep(self.poll_interval)
+            with urllib.request.urlopen(next_uri, timeout=30) as r:
+                state = json.loads(r.read())
+
+    def server_info(self) -> dict:
+        with urllib.request.urlopen(f"{self.server_url}/v1/info", timeout=10) as r:
+            return json.loads(r.read())

@@ -35,7 +35,7 @@ from ..ops.relops import (
 )
 from ..plan.nodes import (
     Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit, PlanNode,
-    Project, Sort, TableScan, TopN, Values, Window,
+    Project, RemoteSource, Sort, TableScan, TopN, Values, Window,
 )
 
 __all__ = ["LocalExecutor"]
@@ -76,7 +76,12 @@ class LocalExecutor:
     def __init__(self, catalogs: CatalogManager, default_catalog: str = "tpch"):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
-        self._table_cols: dict[tuple[str, str, str], Column] = {}
+        # (part, num_parts): which slice of every table this executor scans —
+        # (0, 1) = whole table; worker tasks get their assigned split range
+        # (reference: SplitAssignment in TaskUpdateRequest)
+        self.split = (0, 1)
+        self._table_cols: dict = {}
+        self._table_empty: dict = {}  # (catalog, table, gen, split) -> padded-empty?
         self._jit_cache: dict = {}
         # caps that completed a query without overflow, keyed by plan: repeat
         # executions skip the growth retries (the reference's runtime-adaptive
@@ -91,28 +96,49 @@ class LocalExecutor:
         conn = self.catalogs.get(catalog)
         schema = conn.table_schema(table)
         gen = getattr(conn, "generation", 0)  # writable connectors bump this
-        key_of = lambda c: (catalog, table, c, gen)
+        key_of = lambda c: (catalog, table, c, gen, self.split)
         missing = [c for c in columns if key_of(c) not in self._table_cols]
         if missing:
-            splits = conn.get_splits(table, 1)
+            part, num_parts = self.split
+            splits = [
+                s
+                for i, s in enumerate(conn.get_splits(table, num_parts))
+                if i % num_parts == part or num_parts == 1
+            ]
             data = conn.read_split(splits[0], missing)
             for s in splits[1:]:
                 more = conn.read_split(s, missing)
                 data = {c: np.concatenate([data[c], more[c]]) for c in missing}
             for c in missing:
-                self._table_cols[key_of(c)] = Column.from_numpy(
-                    schema.type_of(c), data[c]
-                )
-        return Page(tuple(self._table_cols[key_of(c)] for c in columns))
+                arr = data[c]
+                if len(arr) == 0:  # kernels need capacity >= 1: pad one dead row
+                    t = schema.type_of(c)
+                    arr = np.zeros((1,), dtype=object if t.is_string else t.np_dtype)
+                    if t.is_string:
+                        arr[0] = ""
+                    self._table_empty[(catalog, table, gen, self.split)] = True
+                self._table_cols[key_of(c)] = Column.from_numpy(schema.type_of(c), arr)
+        cols = tuple(self._table_cols[key_of(c)] for c in columns)
+        live = None
+        if self._table_empty.get((catalog, table, gen, self.split)):
+            live = jnp.zeros((cols[0].capacity if cols else 1,), jnp.bool_)
+        return Page(cols, live)
 
     # ------------------------------------------------------------ execution
-    def execute(self, plan: PlanNode) -> Page:
+    def execute(
+        self, plan: PlanNode, remote_pages: Optional[dict[int, Page]] = None
+    ) -> Page:
+        """remote_pages: fragment_id -> input Page for RemoteSource leaves
+        (multi-host task execution, runtime/worker.py)."""
         nodes = _node_ids(plan)
-        scans = {i: n for i, n in nodes.items() if isinstance(n, TableScan)}
-        inputs = {
-            str(i): self.table_page(n.catalog, n.table, n.column_names, n.output_types)
-            for i, n in scans.items()
-        }
+        inputs = {}
+        for i, n in nodes.items():
+            if isinstance(n, TableScan):
+                inputs[str(i)] = self.table_page(
+                    n.catalog, n.table, n.column_names, n.output_types
+                )
+            elif isinstance(n, RemoteSource):
+                inputs[str(i)] = remote_pages[n.fragment_id]
         caps = self._learned_caps.get(plan) or self._initial_caps(nodes, inputs)
         for _ in range(12):  # capacity-retry loop
             out_page, required = self._run(plan, inputs, caps)
@@ -137,7 +163,7 @@ class LocalExecutor:
         sizes: dict[int, int] = {}
 
         def size_of(nid: int, n: PlanNode) -> int:
-            if isinstance(n, TableScan):
+            if isinstance(n, (TableScan, RemoteSource)):
                 return inputs[str(nid)].capacity
             child_ids = _child_ids(nodes, nid)
             child_sizes = [size_of(c, nodes[c]) for c in child_ids]
@@ -206,7 +232,7 @@ def _trace_plan(
         nid = counter[0]
         counter[0] += 1
 
-        if isinstance(node, TableScan):
+        if isinstance(node, (TableScan, RemoteSource)):
             page = pages[str(nid)]
             cols = [column_val(c) for c in page.columns]
             for cv, t in zip(cols, node.output_types):

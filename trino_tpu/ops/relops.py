@@ -216,11 +216,12 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live):
         total *= max(d, 1)
     if not (0 < total <= _DIRECT_DOMAIN_LIMIT):
         return None
+    domains = [max(d, 1) for d in domains]  # empty dicts (all-dead pages)
     n = live.shape[0]
     G = total
     seg = jnp.zeros((n,), jnp.int32)
     for kv, d in zip(key_vals, domains):
-        seg = seg * d + kv.data.astype(jnp.int32)
+        seg = seg * d + jnp.clip(kv.data.astype(jnp.int32), 0, d - 1)
     seg = jnp.where(live, seg, G)
     num = G + 1
     cnt_any = _segment_sum(live.astype(jnp.int64), seg, num)[:G]

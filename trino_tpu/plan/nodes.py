@@ -250,6 +250,26 @@ class Distinct(PlanNode):
 
 
 @dataclass(frozen=True)
+class RemoteSource(PlanNode):
+    """Fragment input: pages fetched from upstream tasks' output buffers
+    (reference: RemoteSourceNode -> ExchangeOperator + DirectExchangeClient,
+    operator/ExchangeOperator.java:44).  Only appears in fragmented
+    multi-host plans (plan/fragmenter.py)."""
+
+    fragment_id: int
+    names: tuple[str, ...]
+    types: tuple[Type, ...]
+
+    @property
+    def output_names(self):
+        return self.names
+
+    @property
+    def output_types(self):
+        return self.types
+
+
+@dataclass(frozen=True)
 class Concat(PlanNode):
     """Row-wise union of same-schema inputs (reference: UNION ALL's
     concatenating exchange / SetOperationNode lowering)."""

@@ -1,0 +1,67 @@
+"""Plan/IR JSON serialization — the TaskUpdateRequest payload.
+
+The reference ships `PlanFragment`s to workers as JSON inside
+TaskUpdateRequest (server/remotetask/, TaskUpdateRequest.java:37-45, with
+Jackson serializers registered per PlanNode/Expression class).  Same
+approach: every frozen dataclass in plan/nodes.py and plan/ir.py encodes as
+{"@": "ClassName", ...fields}; Types encode by SQL name (round-tripped via
+parse_type).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import fields, is_dataclass
+from typing import Any
+
+from ..data.types import DecimalType, Type, parse_type
+from . import ir as IR
+from . import nodes as N
+
+__all__ = ["plan_to_json", "plan_from_json"]
+
+_CLASSES: dict[str, type] = {}
+for mod in (N, IR):
+    for name in dir(mod):
+        obj = getattr(mod, name)
+        if isinstance(obj, type) and is_dataclass(obj):
+            _CLASSES[obj.__name__] = obj
+
+
+def _encode(v: Any) -> Any:
+    if isinstance(v, Type):
+        return {"@t": v.name}
+    if is_dataclass(v) and not isinstance(v, type):
+        out: dict[str, Any] = {"@": type(v).__name__}
+        for f in fields(v):
+            out[f.name] = _encode(getattr(v, f.name))
+        return out
+    if isinstance(v, tuple):
+        return {"@tuple": [_encode(x) for x in v]}
+    if isinstance(v, (list,)):
+        return [_encode(x) for x in v]
+    if v is None or isinstance(v, (str, int, float, bool)):
+        return v
+    raise TypeError(f"cannot serialize {type(v).__name__}: {v!r}")
+
+
+def _decode(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "@t" in v:
+            return parse_type(v["@t"])
+        if "@tuple" in v:
+            return tuple(_decode(x) for x in v["@tuple"])
+        cls = _CLASSES[v["@"]]
+        kwargs = {k: _decode(val) for k, val in v.items() if k != "@"}
+        return cls(**kwargs)
+    if isinstance(v, list):
+        return [_decode(x) for x in v]
+    return v
+
+
+def plan_to_json(plan: N.PlanNode) -> str:
+    return json.dumps(_encode(plan))
+
+
+def plan_from_json(text: str) -> N.PlanNode:
+    return _decode(json.loads(text))

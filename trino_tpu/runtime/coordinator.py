@@ -1,0 +1,332 @@
+"""Coordinator: discovery, scheduling, client protocol.
+
+Reference wiring this replaces (SURVEY §3.1-3.2):
+  - discovery/membership + heartbeat failure detector
+    (node/CoordinatorNodeManager, failuredetector/HeartbeatFailureDetector.java:76)
+  - stage scheduling: fragments run children-first, one task per worker per
+    stage, splits assigned round-robin
+    (execution/scheduler/PipelinedQueryScheduler.java:164 — here stage-by-
+    stage like the FTE scheduler rather than pipelined)
+  - client protocol: POST /v1/statement, poll GET nextUri
+    (dispatcher/QueuedStatementResource.java:109, server/protocol/
+    ExecutingStatementResource.java), results paged from the root stage
+  - query-level retry on worker failure (RetryPolicy QUERY)
+
+The root (result) fragment executes in the coordinator process — the
+reference's COORDINATOR_DISTRIBUTION output stage
+(PipelinedQueryScheduler.java:535 CoordinatorStagesScheduler).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import urllib.request
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..connectors.spi import CatalogManager
+from ..data.page import Page
+from ..exec.compiler import LocalExecutor
+from ..plan.distribute import distribute
+from ..plan.fragmenter import Fragment, fragment_plan
+from ..plan.optimizer import optimize
+from ..plan.planner import Planner
+from ..plan.serde import _encode, plan_to_json
+from .session import SessionProperties
+from .statemachine import QueryStateMachine
+from .wire import wire_to_page
+
+__all__ = ["Coordinator"]
+
+
+class _WorkerInfo:
+    def __init__(self, url: str):
+        self.url = url
+        self.alive = True
+        self.last_seen = time.time()
+        self.failures = 0
+
+
+class Coordinator:
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        default_catalog: str = "tpch",
+        port: int = 0,
+        heartbeat_interval: float = 2.0,
+    ):
+        self.catalogs = catalogs
+        self.default_catalog = default_catalog
+        self.planner = Planner(catalogs, default_catalog)
+        self.session = SessionProperties()
+        self.workers: dict[str, _WorkerInfo] = {}
+        self.queries: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.heartbeat_interval = heartbeat_interval
+        self._hb_stop = threading.Event()
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_port
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._threads = [
+            threading.Thread(target=self.httpd.serve_forever, daemon=True),
+            threading.Thread(target=self._heartbeat_loop, daemon=True),
+        ]
+
+    def start(self) -> "Coordinator":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+        self.httpd.shutdown()
+
+    # ------------------------------------------------------------ discovery
+    def register_worker(self, url: str) -> None:
+        with self._lock:
+            self.workers[url] = _WorkerInfo(url)
+
+    def alive_workers(self) -> list[str]:
+        with self._lock:
+            return [w.url for w in self.workers.values() if w.alive]
+
+    def _heartbeat_loop(self) -> None:
+        """Decayed-failure heartbeat gating (HeartbeatFailureDetector.java:76
+        reduced to consecutive-failure gating)."""
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            with self._lock:
+                infos = list(self.workers.values())
+            for w in infos:
+                try:
+                    with urllib.request.urlopen(f"{w.url}/v1/info", timeout=2) as r:
+                        r.read()
+                    w.alive = True
+                    w.failures = 0
+                    w.last_seen = time.time()
+                except Exception:
+                    w.failures += 1
+                    if w.failures >= 2:
+                        w.alive = False
+
+    # ------------------------------------------------------------ execution
+    def execute_query(self, sql: str) -> list[tuple]:
+        """Synchronous execution (the HTTP protocol wraps this async)."""
+        qid = f"q_{uuid.uuid4().hex[:12]}"
+        sm = QueryStateMachine(qid)
+        record = {"sm": sm, "sql": sql, "result": None, "columns": None}
+        with self._lock:
+            self.queries[qid] = record
+        self._run(record)
+        if sm.state == "FAILED":
+            raise RuntimeError(sm.error)
+        return record["result"]
+
+    def submit_query(self, sql: str) -> str:
+        qid = f"q_{uuid.uuid4().hex[:12]}"
+        sm = QueryStateMachine(qid)
+        record = {"sm": sm, "sql": sql, "result": None, "columns": None}
+        with self._lock:
+            self.queries[qid] = record
+        threading.Thread(target=self._run, args=(record,), daemon=True).start()
+        return qid
+
+    def _run(self, record: dict) -> None:
+        sm: QueryStateMachine = record["sm"]
+        retries = 1 if self.session.get("retry_policy") == "QUERY" else 0
+        for attempt in range(retries + 1):
+            try:
+                sm.transition("PLANNING")
+                self._run_once(record)
+                sm.transition("FINISHED")
+                return
+            except Exception as e:
+                if attempt < retries:
+                    continue  # query-level retry (RetryPolicy QUERY)
+                traceback.print_exc()
+                sm.fail(str(e))
+                return
+
+    def _run_once(self, record: dict) -> None:
+        sm: QueryStateMachine = record["sm"]
+        workers = self.alive_workers()
+        if not workers:
+            raise RuntimeError("no alive workers")
+        nw = len(workers)
+
+        plan = optimize(self.planner.plan(record["sql"]))
+        dplan = distribute(plan, self.catalogs, nw, self.session)
+        fragments = fragment_plan(dplan)
+        record["columns"] = list(plan.output_names)
+
+        sm.transition("STARTING")
+        # task counts: result fragment runs on the coordinator; leaf/mid
+        # stages get one task per worker
+        ntasks = {f.id: (1 if f.output_kind == "result" else nw) for f in fragments}
+        frag_by_id = {f.id: f for f in fragments}
+        consumer_of: dict[int, int] = {}
+        for f in fragments:
+            for child in f.inputs:
+                consumer_of[child] = f.id
+
+        task_urls: dict[int, list[tuple[str, str]]] = {}  # frag -> [(url, task_id)]
+        sm.transition("RUNNING")
+        for f in sorted(fragments, key=lambda f: -f.id):
+            if f.output_kind == "result":
+                continue  # runs on coordinator below
+            out_parts = ntasks[consumer_of[f.id]]
+            sources = self._sources_payload(f, frag_by_id, task_urls)
+            payload_base = {
+                "fragment": plan_to_json(f.root),
+                "output_kind": f.output_kind,
+                "output_keys": [_encode(k) for k in f.output_keys],
+                "out_parts": out_parts,
+                "num_parts": ntasks[f.id],
+                "sources": sources,
+            }
+            urls = []
+            with ThreadPoolExecutor(max_workers=max(ntasks[f.id], 1)) as pool:
+                futs = []
+                for p in range(ntasks[f.id]):
+                    w = workers[p % nw]
+                    task_id = f"{sm.query_id}_f{f.id}_p{p}"
+                    payload = dict(payload_base, task_id=task_id, part=p)
+                    futs.append(pool.submit(self._post_task, w, payload))
+                    urls.append((w, task_id))
+                for fut in futs:
+                    fut.result()  # raises on task failure
+            task_urls[f.id] = urls
+
+        # result fragment on the coordinator (COORDINATOR_DISTRIBUTION)
+        root = frag_by_id[0]
+        executor = LocalExecutor(self.catalogs, self.default_catalog)
+        remote_pages: dict[int, Page] = {}
+        from ..data.types import parse_type
+
+        for child_id in root.inputs:
+            child = frag_by_id[child_id]
+            kind = child.output_kind
+            blobs = []
+            for (u, t) in task_urls[child_id]:
+                buffer_id = 0  # result stage is single-partition
+                blobs.append(_http_get(f"{u}/v1/task/{t}/results/{buffer_id}/0"))
+            remote_pages[child_id] = wire_to_page(blobs, list(child.root.output_types))
+        sm.transition("FINISHING")
+        page = executor.execute(root.root, remote_pages)
+        record["result"] = page.to_pylist()
+
+    def _sources_payload(self, f: Fragment, frag_by_id, task_urls) -> dict:
+        out = {}
+        for child_id in f.inputs:
+            child = frag_by_id[child_id]
+            out[str(child_id)] = {
+                "kind": child.output_kind,
+                "tasks": task_urls[child_id],
+                "types": [t.name for t in child.root.output_types],
+            }
+        return out
+
+    def _post_task(self, worker_url: str, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{worker_url}/v1/task/{payload['task_id']}",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(f"task {payload['task_id']} failed on {worker_url}: {detail}")
+
+
+def _http_get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+# ------------------------------------------------------------ HTTP protocol
+
+
+def _make_handler(coord: Coordinator):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send_json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "statement"]:
+                sql = body.decode()
+                qid = coord.submit_query(sql)
+                return self._send_json(
+                    200,
+                    {"id": qid, "nextUri": f"{coord.url}/v1/statement/{qid}/0"},
+                )
+            if parts[:2] == ["v1", "announce"]:
+                req = json.loads(body)
+                coord.register_worker(req["url"])
+                return self._send_json(200, {})
+            return self._send_json(404, {"error": "not found"})
+
+        def do_GET(self):
+            parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "info"]:
+                return self._send_json(
+                    200,
+                    {
+                        "workers": [
+                            {"url": w.url, "alive": w.alive}
+                            for w in coord.workers.values()
+                        ],
+                        "queries": len(coord.queries),
+                    },
+                )
+            if parts[:2] == ["v1", "statement"] and len(parts) >= 4:
+                qid = parts[2]
+                with coord._lock:
+                    record = coord.queries.get(qid)
+                if record is None:
+                    return self._send_json(404, {"error": "unknown query"})
+                sm: QueryStateMachine = record["sm"]
+                if not sm.done:
+                    return self._send_json(
+                        200,
+                        {
+                            "id": qid,
+                            "stats": {"state": sm.state},
+                            "nextUri": f"{coord.url}/v1/statement/{qid}/0",
+                        },
+                    )
+                if sm.state == "FAILED":
+                    return self._send_json(
+                        200,
+                        {"id": qid, "stats": {"state": "FAILED"}, "error": sm.error},
+                    )
+                return self._send_json(
+                    200,
+                    {
+                        "id": qid,
+                        "stats": {"state": sm.state},
+                        "columns": record["columns"],
+                        "data": [list(r) for r in record["result"]],
+                    },
+                )
+            return self._send_json(404, {"error": "not found"})
+
+    return Handler

@@ -1,0 +1,72 @@
+"""Query lifecycle state machine.
+
+Reference: execution/QueryStateMachine.java (1776 lines) driving
+QueryState.java:21-58 (QUEUED -> WAITING_FOR_RESOURCES -> DISPATCHING ->
+PLANNING -> STARTING -> RUNNING -> FINISHING -> FINISHED | FAILED) over the
+generic listener-based StateMachine.java:43.  Same contract: monotone
+transitions, terminal states absorb, listeners fire outside the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["QueryState", "QueryStateMachine", "STATES"]
+
+STATES = [
+    "QUEUED", "PLANNING", "STARTING", "RUNNING", "FINISHING",
+    "FINISHED", "FAILED", "CANCELED",
+]
+_ORDER = {s: i for i, s in enumerate(STATES)}
+TERMINAL = {"FINISHED", "FAILED", "CANCELED"}
+
+
+class QueryState:
+    pass
+
+
+class QueryStateMachine:
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self._state = "QUEUED"
+        self._lock = threading.Lock()
+        self._listeners: list[Callable[[str], None]] = []
+        self.error: Optional[str] = None
+        self.created_at = time.time()
+        self.finished_at: Optional[float] = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def done(self) -> bool:
+        return self._state in TERMINAL
+
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+            current = self._state
+        fn(current)
+
+    def transition(self, new_state: str) -> bool:
+        """Monotone transition; returns False if not applied (terminal or
+        backwards)."""
+        with self._lock:
+            if self._state in TERMINAL:
+                return False
+            if _ORDER[new_state] <= _ORDER[self._state] and new_state not in TERMINAL:
+                return False
+            self._state = new_state
+            if new_state in TERMINAL:
+                self.finished_at = time.time()
+            listeners = list(self._listeners)
+        for fn in listeners:  # outside the lock (reference: StateMachine.java)
+            fn(new_state)
+        return True
+
+    def fail(self, message: str) -> None:
+        self.error = message
+        self.transition("FAILED")

@@ -1,0 +1,176 @@
+"""Worker node: HTTP task execution server.
+
+Reference wiring this replaces (SURVEY §2.8, §3.2):
+  POST /v1/task/{id}      TaskResource.createOrUpdateTask (TaskResource.java:142)
+                          carrying TaskUpdateRequest {fragment, splits,
+                          output layout} -> SqlTaskManager.updateTask:491
+  GET  /v1/task/{id}/results/{buffer}/{token}
+                          TaskResource.java:331 (pipelined data plane)
+  DELETE /v1/task/{id}    task abort
+  GET  /v1/info           heartbeat (failuredetector/HeartbeatFailureDetector)
+  POST /v1/inject_failure test-only fault injection
+                          (reference: execution/FailureInjector.java:33,
+                          TestingTrinoServer.injectTaskFailure)
+
+A task executes its fragment with the jitted LocalExecutor over its split
+range, partitions output rows per the fragment's output kind, and parks the
+wire pages in per-partition buffers for consumers to fetch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..connectors.spi import CatalogManager
+from ..data.page import Page
+from ..exec.compiler import LocalExecutor
+from ..plan.serde import plan_from_json
+from .wire import page_to_wire, partition_page, wire_to_page
+
+__all__ = ["Worker"]
+
+
+class Worker:
+    def __init__(self, catalogs: CatalogManager, default_catalog: str, port: int = 0):
+        self.catalogs = catalogs
+        self.default_catalog = default_catalog
+        self.buffers: dict[tuple[str, int], bytes] = {}
+        self.task_state: dict[str, str] = {}
+        self.injected_failures: set[str] = set()
+        self._lock = threading.Lock()
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_port
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> "Worker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+
+    # ------------------------------------------------------- task execution
+    def run_task(self, req: dict) -> None:
+        task_id = req["task_id"]
+        with self._lock:  # one-shot injection tokens (FailureInjector.java:33)
+            if task_id in self.injected_failures:
+                self.injected_failures.discard(task_id)
+                raise RuntimeError(f"injected failure for task {task_id}")
+            if "*" in self.injected_failures:
+                self.injected_failures.discard("*")
+                raise RuntimeError(f"injected failure for task {task_id}")
+        fragment = plan_from_json(req["fragment"])
+        executor = LocalExecutor(self.catalogs, self.default_catalog)
+        executor.split = (req["part"], req["num_parts"])
+
+        remote_pages: dict[int, Page] = {}
+        for fid_str, src in req.get("sources", {}).items():
+            fid = int(fid_str)
+            kind = src["kind"]
+            my_part = req["part"]
+            if kind == "single" and my_part != 0:
+                blobs = []
+            else:
+                buffer_id = my_part if kind == "repartition" else 0
+                blobs = [
+                    _fetch(f"{u}/v1/task/{t}/results/{buffer_id}/0")
+                    for u, t in src["tasks"]
+                ]
+            from ..data.types import parse_type
+
+            types = [parse_type(t) for t in src["types"]]
+            remote_pages[fid] = wire_to_page(blobs, types)
+
+        page = executor.execute(fragment, remote_pages)
+
+        out_kind = req["output_kind"]
+        out_parts = req["out_parts"]
+        if out_kind == "repartition":
+            from ..plan.serde import _decode
+
+            keys = [_decode(k) for k in req["output_keys"]]
+            blobs = partition_page(page, keys, out_parts)
+            with self._lock:
+                for p, blob in enumerate(blobs):
+                    self.buffers[(task_id, p)] = blob
+        else:  # gather / broadcast / single / result
+            blob = page_to_wire(page)
+            with self._lock:
+                self.buffers[(task_id, 0)] = blob
+        self.task_state[task_id] = "FINISHED"
+
+
+def _fetch(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+def _make_handler(worker: Worker):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes, ctype="application/octet-stream"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "info"]:
+                body = json.dumps(
+                    {"state": "active", "tasks": len(worker.task_state)}
+                ).encode()
+                return self._send(200, body, "application/json")
+            # /v1/task/{id}/results/{buffer}/{token}
+            if len(parts) >= 5 and parts[:2] == ["v1", "task"] and parts[3] == "results":
+                task_id = parts[2]
+                buffer_id = int(parts[4])
+                with worker._lock:
+                    blob = worker.buffers.get((task_id, buffer_id))
+                if blob is None:
+                    return self._send(404, b"no such buffer")
+                return self._send(200, blob)
+            return self._send(404, b"not found")
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "task"]:
+                req = json.loads(body)
+                try:
+                    worker.run_task(req)
+                    return self._send(200, b'{"state": "FINISHED"}', "application/json")
+                except Exception as e:
+                    traceback.print_exc()
+                    msg = json.dumps({"state": "FAILED", "error": str(e)}).encode()
+                    return self._send(500, msg, "application/json")
+            if parts[:2] == ["v1", "inject_failure"]:
+                req = json.loads(body)
+                worker.injected_failures.add(req.get("task_id", "*"))
+                return self._send(200, b"{}", "application/json")
+            return self._send(404, b"not found")
+
+        def do_DELETE(self):
+            parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "task"]:
+                task_id = parts[2]
+                with worker._lock:
+                    worker.buffers = {
+                        k: v for k, v in worker.buffers.items() if k[0] != task_id
+                    }
+                    worker.task_state.pop(task_id, None)
+                return self._send(200, b"{}")
+            return self._send(404, b"not found")
+
+    return Handler
