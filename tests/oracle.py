@@ -63,11 +63,18 @@ def to_sqlite(sql: str) -> str:
 
 
 class SqliteOracle:
-    def __init__(self, tables: dict[str, dict[str, np.ndarray]]):
+    def __init__(
+        self,
+        tables: dict[str, dict[str, np.ndarray]],
+        schemas: dict[str, list] | None = None,
+    ):
+        all_schemas = dict(TPCH_SCHEMAS)
+        if schemas is not None:
+            all_schemas.update(schemas)
         self.conn = sqlite3.connect(":memory:")
         self.conn.create_function("power", 2, lambda a, b: float(a) ** float(b))
         for name, cols in tables.items():
-            schema = dict(TPCH_SCHEMAS[name])
+            schema = dict(all_schemas[name])
             col_defs = ", ".join(f"{c} {_sqlite_type(schema[c])}" for c in cols)
             self.conn.execute(f"CREATE TABLE {name} ({col_defs})")
             arrays = []

@@ -1,0 +1,199 @@
+"""TPC-DS query subset (official query shapes, substitution parameters
+chosen to select rows in the generated distributions).
+
+Exercises the star-schema join patterns, partial/final aggregation over
+repartition exchanges, window-over-aggregate ratios, correlated scalar
+subqueries over CTEs, and EXISTS — the patterns north-star config #4
+(TPC-DS Q64/Q95-class plans) is made of.
+"""
+
+QUERIES: dict[str, str] = {}
+ORDERED: dict[str, bool] = {}
+
+QUERIES["q01"] = """
+with customer_total_return as (
+  select sr_customer_sk as ctr_customer_sk, sr_store_sk as ctr_store_sk,
+         sum(sr_return_amt) as ctr_total_return
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk and d_year = 2000
+  group by sr_customer_sk, sr_store_sk
+)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return > (
+    select avg(ctr_total_return) * 1.2 from customer_total_return ctr2
+    where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk and s_state = 'CA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+"""
+ORDERED["q01"] = True
+
+QUERIES["q03"] = """
+select d_year, i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manufact_id = 128 and d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, brand_id
+limit 100
+"""
+ORDERED["q03"] = False  # ties in sum_agg
+
+QUERIES["q07"] = """
+select i_item_id, avg(ss_quantity) as agg1, avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3, avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+ORDERED["q07"] = True
+
+QUERIES["q12"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+  sum(ws_ext_sales_price) as itemrevenue,
+  sum(ws_ext_sales_price) * 100 / sum(sum(ws_ext_sales_price)) over
+    (partition by i_class) as revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ws_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-02-22' + interval '30' day
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+ORDERED["q12"] = False
+
+QUERIES["q19"] = """
+select i_brand_id as brand_id, i_brand as brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+  and ss_customer_sk = c_customer_sk and c_current_addr_sk = ca_address_sk
+  and substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
+  and ss_store_sk = s_store_sk
+group by i_brand_id, i_brand, i_manufact_id, i_manufact
+order by ext_price desc, brand_id, i_manufact_id
+limit 100
+"""
+ORDERED["q19"] = False
+
+QUERIES["q26"] = """
+select i_item_id, avg(cs_quantity) as agg1, avg(cs_list_price) as agg2,
+       avg(cs_coupon_amt) as agg3, avg(cs_sales_price) as agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+  and cd_gender = 'F' and cd_marital_status = 'W'
+  and cd_education_status = 'Primary'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+ORDERED["q26"] = True
+
+QUERIES["q42"] = """
+select d_year, i_category_id, i_category, sum(ss_ext_sales_price) as s
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+group by d_year, i_category_id, i_category
+order by s desc, d_year, i_category_id, i_category
+limit 100
+"""
+ORDERED["q42"] = False
+
+QUERIES["q52"] = """
+select d_year, i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, brand_id
+limit 100
+"""
+ORDERED["q52"] = False
+
+QUERIES["q55"] = """
+select i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id
+limit 100
+"""
+ORDERED["q55"] = False
+
+QUERIES["q96"] = """
+select count(*) as cnt
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour = 20 and t_minute >= 30 and hd_dep_count = 7
+  and s_store_name = 'ese'
+order by cnt
+limit 100
+"""
+ORDERED["q96"] = True
+
+QUERIES["q98"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+  sum(ss_ext_sales_price) as itemrevenue,
+  sum(ss_ext_sales_price) * 100 / sum(sum(ss_ext_sales_price)) over
+    (partition by i_class) as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Jewelry', 'Sports', 'Books')
+  and ss_sold_date_sk = d_date_sk
+  and d_date between date '2001-01-12' and date '2001-01-12' + interval '30' day
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+"""
+ORDERED["q98"] = False
+
+QUERIES["q20"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+  sum(cs_ext_sales_price) as itemrevenue,
+  sum(cs_ext_sales_price) * 100 / sum(sum(cs_ext_sales_price)) over
+    (partition by i_class) as revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-02-22' + interval '30' day
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+ORDERED["q20"] = False
+
+QUERIES["q37"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 20 and 50
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2000-02-01' and date '2000-02-01' + interval '60' day
+  and i_manufact_id in (678, 964, 918, 849)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+ORDERED["q37"] = True
