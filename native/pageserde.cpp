@@ -121,7 +121,10 @@ int32_t tt_page_peek(const uint8_t* data, int64_t len, int32_t* ncols,
   Header h;
   std::memcpy(&h, data, sizeof(h));
   if (h.magic != kMagic) return -2;
-  if (static_cast<int32_t>(h.ncols) > max_cols) return -3;
+  if (h.ncols > static_cast<uint32_t>(max_cols)) return -3;
+  // truncated frame: every per-column header + the checksum must be present
+  if (len < static_cast<int64_t>(sizeof(Header) + 17ull * h.ncols + 8))
+    return -7;
   *ncols = h.ncols;
   *nrows = h.nrows;
   const uint8_t* hp = data + sizeof(Header);
@@ -137,9 +140,12 @@ int32_t tt_page_peek(const uint8_t* data, int64_t len, int32_t* ncols,
 // tt_page_peek).  Verifies the checksum.  Returns 0 on success.
 int32_t tt_page_deserialize(const uint8_t* data, int64_t len,
                             uint8_t** out_bufs) {
+  if (len < static_cast<int64_t>(sizeof(Header))) return -1;
   Header h;
   std::memcpy(&h, data, sizeof(h));
   if (h.magic != kMagic) return -2;
+  if (len < static_cast<int64_t>(sizeof(Header) + 17ull * h.ncols + 8))
+    return -7;
   const uint8_t* hp = data + sizeof(Header);
   const uint8_t* p = hp + 17ull * h.ncols;
   uint64_t stored_checksum;

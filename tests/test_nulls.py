@@ -1,0 +1,203 @@
+"""NULL-semantics regression tests (round-1 advisor findings).
+
+Covers: null-aware NOT IN (three-valued logic), validity preservation through
+the CTAS / INSERT...SELECT write path, grouped COUNT(DISTINCT) with NULL
+lanes, NULL-key routing in the wire partitioner, and the native serde's
+all-empty-string dictionary round trip.  Reference semantics:
+SemiJoinNode null-aware rewrite, spi Block isNull bitmaps through
+ConnectorPageSink.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="memory")
+    eng.register_catalog("memory", MemoryConnector())
+    return eng
+
+
+def _setup_not_in(engine, probe_vals, build_vals):
+    engine.execute("drop table if exists probe")
+    engine.execute("drop table if exists build")
+    engine.execute("create table probe (x bigint)")
+    engine.execute("create table build (y bigint)")
+    if probe_vals:
+        engine.execute(
+            "insert into probe values "
+            + ", ".join(f"({'null' if v is None else v})" for v in probe_vals)
+        )
+    if build_vals:
+        engine.execute(
+            "insert into build values "
+            + ", ".join(f"({'null' if v is None else v})" for v in build_vals)
+        )
+
+
+def test_not_in_basic(engine):
+    _setup_not_in(engine, [1, 2, 3], [2])
+    rows = engine.execute(
+        "select x from probe where x not in (select y from build) order by x"
+    )
+    assert rows == [(1,), (3,)]
+
+
+def test_not_in_null_probe_filtered(engine):
+    # NULL NOT IN (non-empty set) => NULL => filtered
+    _setup_not_in(engine, [1, None, 3], [2])
+    rows = engine.execute(
+        "select x from probe where x not in (select y from build) order by x"
+    )
+    assert rows == [(1,), (3,)]
+
+
+def test_not_in_null_in_build_filters_all_nonmatches(engine):
+    # x NOT IN (..., NULL) is never TRUE: matches are FALSE, rest are NULL
+    _setup_not_in(engine, [1, 2, 3], [2, None])
+    rows = engine.execute(
+        "select x from probe where x not in (select y from build)"
+    )
+    assert rows == []
+
+
+def test_not_in_empty_build_keeps_all(engine):
+    # x NOT IN (empty) is TRUE for every row, including NULL x
+    _setup_not_in(engine, [1, None], [])
+    rows = engine.execute(
+        "select count(*) from probe where x not in (select y from build)"
+    )
+    assert rows == [(2,)]
+
+
+def test_in_subquery_still_positive(engine):
+    _setup_not_in(engine, [1, None, 3], [3, None])
+    rows = engine.execute(
+        "select x from probe where x in (select y from build)"
+    )
+    assert rows == [(3,)]
+
+
+# --------------------------------------------------------------- write path
+
+
+def test_ctas_preserves_nulls_from_left_join(engine):
+    engine.execute("create table l (k bigint)")
+    engine.execute("insert into l values (1), (2)")
+    engine.execute("create table r (k bigint, v double)")
+    engine.execute("insert into r values (1, 10.0)")
+    engine.execute(
+        "create table joined as "
+        "select l.k as k, r.v as v from l left join r on l.k = r.k"
+    )
+    rows = engine.execute("select k, v from joined order by k")
+    assert rows == [(1, 10.0), (2, None)]
+    # and NULL-ness survives further queries over the written table
+    assert engine.execute("select count(v) from joined") == [(1,)]
+    assert engine.execute("select k from joined where v is null") == [(2,)]
+
+
+def test_insert_select_preserves_null_literals(engine):
+    engine.execute("create table t (a bigint, b varchar)")
+    engine.execute("insert into t values (1, 'x'), (null, null)")
+    engine.execute("create table u (a bigint, b varchar)")
+    engine.execute("insert into u select a, b from t")
+    rows = engine.execute("select a, b from u order by a nulls first")
+    assert rows == [(None, None), (1, "x")]
+
+
+# ------------------------------------------------- grouped COUNT(DISTINCT)
+
+
+def test_grouped_count_distinct_ignores_nulls(engine):
+    engine.execute("create table t (g bigint, v bigint)")
+    engine.execute(
+        "insert into t values "
+        "(1, 10), (1, 10), (1, null), (1, 20), "
+        "(2, null), (2, null), "
+        "(3, 30)"
+    )
+    rows = engine.execute(
+        "select g, count(distinct v) from t group by g order by g"
+    )
+    assert rows == [(1, 2), (2, 0), (3, 1)]
+
+
+# ------------------------------------------------------ wire partitioning
+
+
+def test_partition_page_routes_null_keys_to_part0():
+    from trino_tpu.data.page import Column, Page
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.native import page_serde
+    from trino_tpu.plan.ir import FieldRef
+    from trino_tpu.runtime.wire import partition_page
+
+    data = np.array([1, 2, 3, 4, 5, 6, 7, 8], dtype=np.int64)
+    valid = np.array([True, False, True, False, True, True, False, True])
+    page = Page((Column.from_numpy(BIGINT, data, valid),))
+    parts = partition_page(page, [FieldRef(0, BIGINT)], 4)
+    # every NULL-key row must land in partition 0
+    null_rows = 0
+    for p, blob in enumerate(parts):
+        cols = page_serde().deserialize_columns(blob)
+        v = cols.get("v0000")
+        if v is None:
+            continue
+        n_null = int((~v.astype(bool)).sum())
+        if p != 0:
+            assert n_null == 0, f"NULL-key row routed to partition {p}"
+        null_rows += n_null
+    assert null_rows == 3
+
+
+def test_distributed_group_by_nullable_key(engine):
+    # one NULL group even when rows spread across partitions
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="memory", distributed=True)
+    eng.register_catalog("memory", MemoryConnector())
+    eng.execute("create table t (g bigint, v bigint)")
+    vals = [(i % 3 if i % 4 else None, i) for i in range(40)]
+    eng.execute(
+        "insert into t values "
+        + ", ".join(f"({'null' if g is None else g}, {v})" for g, v in vals)
+    )
+    rows = eng.execute("select g, count(*) from t group by g order by g nulls first")
+    expect = {}
+    for g, _ in vals:
+        expect[g] = expect.get(g, 0) + 1
+    assert rows == sorted(
+        expect.items(), key=lambda kv: (kv[0] is not None, kv[0] or 0)
+    )
+
+
+# ------------------------------------------------------------ native serde
+
+
+def test_serde_all_empty_string_dictionary_roundtrip():
+    from trino_tpu.native import page_serde
+
+    cols = {
+        "s": np.array(["", "", ""], dtype=object),
+        "x": np.arange(3, dtype=np.int64),
+    }
+    out = page_serde().serialize_columns(cols)
+    back = page_serde().deserialize_columns(out)
+    assert list(back["s"]) == ["", "", ""]
+    assert len(back["x"]) == 3
+
+
+def test_serde_truncated_frame_rejected():
+    from trino_tpu.native import page_serde
+
+    cols = {"x": np.arange(100, dtype=np.int64)}
+    blob = page_serde().serialize_columns(cols)
+    for cut in (4, 10, len(blob) // 2):
+        with pytest.raises(Exception):
+            page_serde().deserialize_columns(blob[:cut])

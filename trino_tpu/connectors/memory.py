@@ -64,7 +64,11 @@ class MemoryConnector(Connector):
         n = len(next(iter(columns.values()))) if columns else 0
         for c in schema.columns:
             arr = columns[c.name]
-            data[c.name] = np.concatenate([data[c.name], arr])
+            old = data[c.name]
+            if isinstance(arr, np.ma.MaskedArray) or isinstance(old, np.ma.MaskedArray):
+                data[c.name] = np.ma.concatenate([old, arr])
+            else:
+                data[c.name] = np.concatenate([old, arr])
         self.generation += 1
         return n
 

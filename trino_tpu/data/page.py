@@ -106,6 +106,13 @@ class Column:
 
     @staticmethod
     def from_numpy(type_: Type, values: np.ndarray, valid: Optional[np.ndarray] = None) -> "Column":
+        if isinstance(values, np.ma.MaskedArray):
+            mask = np.ma.getmaskarray(values)
+            fill = "" if type_.is_string else 0
+            values = values.filled(fill)
+            if mask.any():
+                ok = ~mask
+                valid = ok if valid is None else (np.asarray(valid) & ok)
         if type_.is_string:
             codes, dictionary = Dictionary.encode(values)
             return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
@@ -192,7 +199,12 @@ class Page:
 
     def to_numpy_columns(self) -> list[np.ndarray]:
         """Compact live rows to host column arrays (connector write path:
-        VARCHAR decodes to object strings, DATE stays as day counts)."""
+        VARCHAR decodes to object strings, DATE stays as day counts).
+
+        Columns containing NULLs come back as ``np.ma.MaskedArray`` (mask ==
+        isNull) so CREATE TABLE AS / INSERT...SELECT persist validity instead
+        of the garbage lane values (the reference's Block keeps its isNull
+        bitmap through the ConnectorPageSink write path)."""
         live = np.asarray(self.live_mask())
         idx = np.nonzero(live)[0]
         out: list[np.ndarray] = []
@@ -205,6 +217,10 @@ class Page:
                     ]
                 else:
                     data = np.array([], dtype=object)
+            if col.valid is not None:
+                invalid = ~np.asarray(col.valid)[idx]
+                if invalid.any():
+                    data = np.ma.MaskedArray(data, mask=invalid)
             out.append(data)
         return out
 

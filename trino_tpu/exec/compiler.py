@@ -64,6 +64,12 @@ def _node_ids(plan: PlanNode) -> dict[int, PlanNode]:
     return out
 
 
+# Below this many total input rows, capacity sizing runs eagerly (op-by-op
+# dispatch, no compile); above it, eager dispatch overhead would beat the
+# compile savings and the jitted retry loop handles growth.
+_EAGER_SIZING_LIMIT = 4_000_000
+
+
 def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
@@ -108,7 +114,15 @@ class LocalExecutor:
             data = conn.read_split(splits[0], missing)
             for s in splits[1:]:
                 more = conn.read_split(s, missing)
-                data = {c: np.concatenate([data[c], more[c]]) for c in missing}
+                data = {
+                    c: (
+                        np.ma.concatenate([data[c], more[c]])
+                        if isinstance(data[c], np.ma.MaskedArray)
+                        or isinstance(more[c], np.ma.MaskedArray)
+                        else np.concatenate([data[c], more[c]])
+                    )
+                    for c in missing
+                }
             for c in missing:
                 arr = data[c]
                 if len(arr) == 0:  # kernels need capacity >= 1: pad one dead row
@@ -139,8 +153,29 @@ class LocalExecutor:
                 )
             elif isinstance(n, RemoteSource):
                 inputs[str(i)] = remote_pages[n.fragment_id]
-        caps = self._learned_caps.get(plan) or self._initial_caps(nodes, inputs)
-        for _ in range(12):  # capacity-retry loop
+        caps = self._learned_caps.get(plan)
+        if caps is None:
+            caps = self._initial_caps(nodes, inputs)
+            total_rows = sum(p.capacity for p in inputs.values())
+            if total_rows <= _EAGER_SIZING_LIMIT:
+                # Converge capacities EAGERLY (op-by-op dispatch, per-op jit
+                # cache — NOT jax.disable_jit(), whose interpreted lax.sort
+                # is pathologically slow): deep plans (TPC-DS CTE trees)
+                # otherwise pay a whole-plan recompile per overflowing node —
+                # the round-1 4.5–222s/query pathology.  Cheap eager loop,
+                # then a single full jit below.
+                for _ in range(16):
+                    _, required = _trace_plan(plan, inputs, caps)
+                    overflow = {
+                        nid: int(req)
+                        for nid, req in required.items()
+                        if nid in caps and int(req) > caps[nid]
+                    }
+                    if not overflow:
+                        break
+                    for nid, req in overflow.items():
+                        caps[nid] = _pow2(max(req, caps[nid] * 2))
+        for _ in range(12):  # capacity-retry loop (jitted path)
             out_page, required = self._run(plan, inputs, caps)
             overflow = {
                 nid: int(req)
@@ -173,7 +208,7 @@ class LocalExecutor:
                 caps[nid] = min(_pow2(max(child_sizes[0], 1)), 65536)
                 return caps[nid]
             if isinstance(n, Join):
-                if n.kind in ("semi", "anti"):
+                if n.kind in ("semi", "anti", "null_anti"):
                     caps[nid] = _pow2(max(max(child_sizes), 1))
                     return child_sizes[0]
                 if n.kind == "cross":
@@ -222,6 +257,13 @@ def _trace_plan(
     overflow counters are pmax-reduced so every device agrees on retries."""
     required: dict[int, jnp.ndarray] = {}
     counter = [0]
+    # Structural CSE: a WITH clause referenced twice plans as two structurally
+    # equal subtrees (planner re-inlines the CTE); emit each distinct subtree
+    # once and reuse its stage.  The reference gets this from iterative-
+    # optimizer plan-node sharing; here frozen-dataclass equality is the memo
+    # key.  Node-id numbering stays in pre-order, so on reuse the counter
+    # skips the subtree's id range.
+    memo: dict[PlanNode, "_Stage"] = {}
 
     def report(nid: int, value):
         if axis is not None:
@@ -229,6 +271,25 @@ def _trace_plan(
         required[nid] = value
 
     def emit(node: PlanNode) -> _Stage:
+        try:
+            cached = memo.get(node)
+        except TypeError:  # unhashable payload somewhere; trace normally
+            cached = None
+            hashable = False
+        else:
+            hashable = True
+        if cached is not None:
+            counter[0] += len(_node_ids(node))
+            return _Stage(
+                [ColumnVal(cv.data, cv.valid, cv.dict, cv.type) for cv in cached.cols],
+                cached.live,
+            )
+        stage = _emit(node)
+        if hashable:
+            memo[node] = stage
+        return stage
+
+    def _emit(node: PlanNode) -> _Stage:
         nid = counter[0]
         counter[0] += 1
 

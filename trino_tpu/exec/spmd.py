@@ -30,7 +30,9 @@ from ..connectors.spi import CatalogManager
 from ..data.page import Column, Page
 from ..parallel.exchange import AXIS
 from ..plan.nodes import Exchange, Join, PlanNode, TableScan, TopN
-from .compiler import LocalExecutor, _child_ids, _node_ids, _pow2, _trace_plan
+from .compiler import (
+    _EAGER_SIZING_LIMIT, LocalExecutor, _child_ids, _node_ids, _pow2, _trace_plan,
+)
 
 __all__ = ["SpmdExecutor"]
 
@@ -80,7 +82,27 @@ class SpmdExecutor(LocalExecutor):
         nodes = _node_ids(plan)
         scans = {i: n for i, n in nodes.items() if isinstance(n, TableScan)}
         inputs = {str(i): self.sharded_table_page(n) for i, n in scans.items()}
-        caps = self._learned_caps.get(plan) or self._initial_caps_spmd(nodes, inputs)
+        caps = self._learned_caps.get(plan)
+        if caps is None:
+            caps = self._initial_caps_spmd(nodes, inputs)
+            total_rows = sum(p.capacity for p in inputs.values())
+            if total_rows <= _EAGER_SIZING_LIMIT:
+                # converge capacities with EAGER shard_map execution (per-op
+                # dispatch, no whole-program compile per attempt) — same
+                # rationale as LocalExecutor: each retry otherwise recompiles
+                # the whole SPMD program, which on a virtual 8-device CPU
+                # mesh costs minutes
+                for _ in range(16):
+                    _, required = self._run_spmd(plan, inputs, caps, eager=True)
+                    overflow = {
+                        nid: int(req)
+                        for nid, req in required.items()
+                        if nid in caps and int(req) > caps[nid]
+                    }
+                    if not overflow:
+                        break
+                    for nid, req in overflow.items():
+                        caps[nid] = _pow2(max(req, caps[nid] * 2))
         for _ in range(14):
             out_page, required = self._run_spmd(plan, inputs, caps)
             overflow = {
@@ -121,7 +143,7 @@ class SpmdExecutor(LocalExecutor):
                 if n.kind == "cross":
                     return child_sizes[0]
                 caps[nid] = _pow2(max(max(child_sizes), 1))
-                if n.kind in ("semi", "anti"):
+                if n.kind in ("semi", "anti", "null_anti"):
                     return child_sizes[0]
                 if n.kind == "left":
                     return caps[nid] + child_sizes[0]
@@ -133,31 +155,44 @@ class SpmdExecutor(LocalExecutor):
         size_of(0, nodes[0])
         return caps
 
-    def _run_spmd(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
+    def _run_spmd(
+        self,
+        plan: PlanNode,
+        inputs: dict[str, Page],
+        caps: dict[int, int],
+        eager: bool = False,
+    ):
         try:
             from jax import shard_map
         except ImportError:  # older jax
             from jax.experimental.shard_map import shard_map
 
         D = self.num_devices
-        cache_key = ("spmd", plan, tuple(sorted(caps.items())),
-                     tuple(sorted((k, p.capacity) for k, p in inputs.items())))
-        if cache_key not in self._jit_cache:
-            mesh = self.mesh
+        mesh = self.mesh
 
-            def step(pages):
-                return _trace_plan(plan, pages, caps, D, AXIS)
+        def step(pages):
+            return _trace_plan(plan, pages, caps, D, AXIS)
 
+        def smap(fn):
             try:
-                smapped = shard_map(
-                    step, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(),
+                return shard_map(
+                    fn, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(),
                     check_vma=False,
                 )
             except TypeError:  # pre-0.8 jax uses check_rep
-                smapped = shard_map(
-                    step, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(),
+                return shard_map(
+                    fn, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(),
                     check_rep=False,
                 )
+
+        if eager:
+            out_page, required = smap(step)(inputs)
+            return out_page, jax.device_get(required)
+
+        cache_key = ("spmd", plan, tuple(sorted(caps.items())),
+                     tuple(sorted((k, p.capacity) for k, p in inputs.items())))
+        if cache_key not in self._jit_cache:
+            smapped = smap(step)
             self._jit_cache[cache_key] = jax.jit(lambda pages: smapped(pages))
         out_page, required = self._jit_cache[cache_key](inputs)
         return out_page, jax.device_get(required)

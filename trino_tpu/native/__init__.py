@@ -148,13 +148,23 @@ class PageSerde:
         buffers: list[bytes] = []
         schema = []
         nrows = len(next(iter(columns.values()))) if columns else 0
+        import struct
+
         for name in names:
             arr = columns[name]
             if arr.dtype == object:
                 uniq, codes = np.unique(arr.astype(str), return_inverse=True)
-                blob = "\x00".join(uniq.tolist()).encode("utf-8")
+                # length-prefixed entries (not NUL-joined): an entry count of
+                # 1 with value "" is distinguishable from 0 entries, so an
+                # all-NULL/all-"" column round-trips instead of collapsing to
+                # a ragged zero-length column
+                parts = [struct.pack("<I", len(uniq))]
+                for v in uniq.tolist():
+                    b = v.encode("utf-8")
+                    parts.append(struct.pack("<I", len(b)))
+                    parts.append(b)
                 buffers.append(codes.astype(np.int32).tobytes())
-                buffers.append(blob)
+                buffers.append(b"".join(parts))
                 schema.append({"name": name, "kind": "dict"})
             else:
                 buffers.append(np.ascontiguousarray(arr).tobytes())
@@ -170,13 +180,23 @@ class PageSerde:
         schema = json.loads(buffers[0].decode("utf-8"))
         out: dict[str, np.ndarray] = {}
         i = 1
+        import struct
+
         for col in schema:
             if col["kind"] == "dict":
                 codes = np.frombuffer(buffers[i], dtype=np.int32)
                 i += 1
-                blob = buffers[i].decode("utf-8")
+                blob = buffers[i]
                 i += 1
-                values = np.asarray(blob.split("\x00") if blob else [], dtype=object)
+                (count,) = struct.unpack_from("<I", blob, 0)
+                off = 4
+                entries = []
+                for _ in range(count):
+                    (ln,) = struct.unpack_from("<I", blob, off)
+                    off += 4
+                    entries.append(blob[off : off + ln].decode("utf-8"))
+                    off += ln
+                values = np.asarray(entries, dtype=object)
                 out[col["name"]] = (
                     values[codes] if len(values) else np.array([], dtype=object)
                 )

@@ -155,6 +155,10 @@ def group_aggregate(
     if len(distinct_args) > 1:
         raise NotImplementedError("at most one DISTINCT aggregate per node")
     for da in distinct_args:
+        # validity sorts before the value (as in _global_aggregate) so a NULL
+        # lane whose code equals a live value cannot become the "first
+        # occurrence" and suppress that value's contribution
+        operands.append((~_valid_of(da, n)).astype(jnp.int8))
         operands.append(_sortable_key(da))
     iota = jnp.arange(n, dtype=jnp.int32)
     sorted_ops = jax.lax.sort(operands + [iota], num_keys=len(operands))
@@ -431,11 +435,17 @@ def equi_join(
     residual: Optional[Callable[[list[ColumnVal], int], jnp.ndarray]],
     out_capacity: int,
 ):
-    """Sort + searchsorted equi-join.  kind: inner | left | semi | anti.
+    """Sort + searchsorted equi-join.  kind: inner | left | semi | anti |
+    null_anti.
 
     inner/left -> (out_cols, out_live, required) with capacity
       out_capacity (+ n_left extra lanes for left-join unmatched rows).
     semi/anti  -> (left_cols, new_live, required): filters the left page.
+    null_anti is the NOT IN lowering (reference: SemiJoinNode + the
+      null-aware rewrite in TransformCorrelatedInPredicateToJoin): with a
+      non-empty build side, probe rows whose key is NULL — or any probe row
+      when the build side contains a NULL key — evaluate NOT IN to NULL and
+      are filtered; an empty build side keeps every probe row.
     `required` is the true expansion size for the host's retry loop.
     """
     nl = left_live.shape[0]
@@ -499,12 +509,26 @@ def equi_join(
 
     required = total
 
-    if kind in ("semi", "anti"):
+    if kind in ("semi", "anti", "null_anti"):
         hit = jnp.zeros((nl,), jnp.bool_).at[pidx_c].max(match, mode="drop")
         if kind == "semi":
             new_live = left_live & hit
-        else:
+        elif kind == "anti":
             new_live = left_live & ~hit
+        else:  # null_anti: SQL three-valued NOT IN
+            build_any = jnp.any(right_live)
+            build_has_null = jnp.zeros((), jnp.bool_)
+            probe_ok = jnp.ones((nl,), jnp.bool_)
+            for rk in right_keys:
+                build_has_null = build_has_null | jnp.any(
+                    right_live & ~_valid_of(rk, nr)
+                )
+            for lk in left_keys:
+                probe_ok = probe_ok & _valid_of(lk, nl)
+            keep = jnp.where(
+                build_any, ~hit & probe_ok & ~build_has_null, True
+            )
+            new_live = left_live & keep
         return list(left_cols), new_live, required
 
     if kind == "inner":

@@ -105,7 +105,7 @@ class _Distributor:
         if isinstance(node, Distinct):
             return max(1.0, 0.5 * self.est_rows(node.child))
         if isinstance(node, Join):
-            if node.kind in ("semi", "anti"):
+            if node.kind in ("semi", "anti", "null_anti"):
                 return self.est_rows(node.left)
             if node.kind == "cross":
                 return self.est_rows(node.left)
@@ -344,6 +344,11 @@ class _Distributor:
             or varchar_keys
             or not node.left_keys
             or rpart.kind == "replicated"
+            # null_anti needs a global view of the build side: a NULL build
+            # key in ANY partition nullifies every probe row, so a
+            # hash-partitioned build (NULLs routed to partition 0) would
+            # give partition-local answers.
+            or node.kind == "null_anti"
         )
 
         if broadcast:

@@ -154,13 +154,13 @@ class Join(PlanNode):
 
     @property
     def output_names(self):
-        if self.kind in ("semi", "anti"):
+        if self.kind in ("semi", "anti", "null_anti"):
             return self.left.output_names
         return self.left.output_names + self.right.output_names
 
     @property
     def output_types(self):
-        if self.kind in ("semi", "anti"):
+        if self.kind in ("semi", "anti", "null_anti"):
             return self.left.output_types
         return self.left.output_types + self.right.output_types
 

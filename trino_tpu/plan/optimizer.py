@@ -102,7 +102,7 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         left_needed = {i for i in needed if i < nl}
         right_needed = (
             set()
-            if node.kind in ("semi", "anti")
+            if node.kind in ("semi", "anti", "null_anti")
             else {i - nl for i in needed if i >= nl}
         )
         for k in node.left_keys:
@@ -130,7 +130,7 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
             None if node.residual is None else remap(node.residual, concat_map),
             node.distribution,
         )
-        if node.kind in ("semi", "anti"):
+        if node.kind in ("semi", "anti", "null_anti"):
             return new, ml
         return new, concat_map
 

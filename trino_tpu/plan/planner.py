@@ -752,8 +752,12 @@ class Planner:
         lkey = translator.translate(e.operand)
         rkey = FieldRef(0, sub.fields[0].type)
         tt = common_super_type(lkey.type, rkey.type)
+        # NOT IN is three-valued: a NULL probe key or any NULL in the
+        # subquery result yields NULL (row filtered), not TRUE — so the
+        # negated lowering is the null-aware anti join, not plain anti
+        # (reference: TransformCorrelatedInPredicateToJoin / SemiJoinNode).
         node = Join(
-            "anti" if negated else "semi",
+            "null_anti" if negated else "semi",
             rel.node,
             sub.node,
             (_cast_ir(lkey, tt),),

@@ -165,7 +165,12 @@ class Engine:
         data = {}
         for cname, arr in zip(names, cols):
             t = schema.type_of(cname)
-            data[cname] = arr if t.is_string else np.asarray(arr).astype(t.np_dtype)
+            if t.is_string or isinstance(arr, np.ma.MaskedArray):
+                # astype on a MaskedArray preserves the mask; np.asarray
+                # would silently strip it and persist garbage for NULL lanes
+                data[cname] = arr if t.is_string else arr.astype(t.np_dtype)
+            else:
+                data[cname] = np.asarray(arr).astype(t.np_dtype)
         n = len(cols[0]) if cols else 0
         for c in schema.columns:  # unreferenced columns default to zero values
             if c.name not in data:
@@ -196,9 +201,18 @@ class Engine:
         for ci, cname in enumerate(names):
             typ = schema.type_of(cname)
             col = [r[ci] for r in rows]
-            data[cname] = np.asarray(
-                col, dtype=object if typ.is_string else typ.np_dtype
-            )
+            nulls = np.array([v is None for v in col], dtype=bool)
+            if nulls.any():
+                fill = "" if typ.is_string else 0
+                arr = np.asarray(
+                    [fill if v is None else v for v in col],
+                    dtype=object if typ.is_string else typ.np_dtype,
+                )
+                data[cname] = np.ma.MaskedArray(arr, mask=nulls)
+            else:
+                data[cname] = np.asarray(
+                    col, dtype=object if typ.is_string else typ.np_dtype
+                )
         for c in schema.columns:
             if c.name not in data:
                 data[c.name] = np.zeros(

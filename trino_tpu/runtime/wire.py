@@ -114,8 +114,11 @@ def partition_page(
     idx = np.nonzero(live)[0]
 
     h = np.zeros(cap, dtype=np.uint64)
+    keys_ok = np.ones(cap, dtype=bool)
     for k in keys:
         kv = eval_expr(k, cols, cap)
+        if kv.valid is not None:
+            keys_ok &= np.asarray(kv.valid)
         if kv.dict is not None:
             table = np.asarray(
                 [_str_hash64(v) for v in kv.dict.values], dtype=np.uint64
@@ -130,6 +133,11 @@ def partition_page(
                 bits = data.astype(np.int64).view(np.uint64)
         h = _mix64_np(h ^ _mix64_np(bits))
     part = (h % np.uint64(max(nparts, 1))).astype(np.int64)
+    # NULL-key rows route to partition 0 (matching the device exchange,
+    # parallel/exchange.py) so e.g. a distributed GROUP BY on a nullable key
+    # keeps the NULL group on one partition instead of splitting it by
+    # whatever garbage the dead lanes carry.
+    part = np.where(keys_ok, part, 0)
 
     datas, valids, _ = _host_columns(page)
     part_live = part[idx]
