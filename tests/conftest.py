@@ -15,6 +15,11 @@ import os
 # real TPU chip), but correctness tests need (a) true float64 — TPU silently
 # computes f64 at f32 precision — and (b) 8 virtual devices for the
 # multi-chip exchange tests.  Hence a hard override, not setdefault.
+# Stash the hardware platform before forcing CPU so the on-TPU differential
+# tier (tests/test_tpch_tpu.py) can re-enable it in a subprocess.  An unset
+# JAX_PLATFORMS means "autodetect" — stash "auto" (not ""), so the tier still
+# probes for hardware on plain TPU VMs where nothing was exported.
+os.environ.setdefault("TRINO_TPU_HW_PLATFORM", os.environ.get("JAX_PLATFORMS") or "auto")
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -24,7 +24,32 @@ from trino_tpu.data.types import DATE, Type, days_to_date
 from trino_tpu.connectors.tpch.generator import TPCH_SCHEMAS
 
 
+def _fold_decimal_literals(sql: str) -> str:
+    """Fold literal-literal +|-|* exactly, as the engine's decimal typing
+    does (0.06 + 0.01 is exactly 0.07 in DECIMAL; in sqlite's f64 it is
+    0.06999..., which flips `between` boundaries on rows at the edge)."""
+    import decimal
+
+    pat = re.compile(r"(?<![\w.])(\d+\.\d+|\d+)\s*([+\-*])\s*(\d+\.\d+|\d+)(?![\w.])")
+
+    def fold(m: re.Match) -> str:
+        a = decimal.Decimal(m.group(1))
+        b = decimal.Decimal(m.group(3))
+        r = {"+": a + b, "-": a - b, "*": a * b}[m.group(2)]
+        return format(r, "f")
+
+    # fold only outside quoted strings ('1994-01-01' must not become 1993)
+    parts = re.split(r"('(?:[^']|'')*')", sql)
+    for i in range(0, len(parts), 2):
+        prev = None
+        while prev != parts[i]:
+            prev = parts[i]
+            parts[i] = pat.sub(fold, parts[i])
+    return "".join(parts)
+
+
 def to_sqlite(sql: str) -> str:
+    sql = _fold_decimal_literals(sql)
     # date '1994-01-01' [+-] interval 'n' unit  ->  date('1994-01-01', '+n units')
     def _interval(m: re.Match) -> str:
         base, sign, n, unit = m.group(1), m.group(2), m.group(3), m.group(4)
@@ -81,6 +106,10 @@ class SqliteOracle:
             for c, arr in cols.items():
                 if schema[c] == DATE:
                     arrays.append([days_to_date(int(d)).isoformat() for d in arr])
+                elif schema[c].is_decimal:
+                    # engine lanes are scaled int64; sqlite sees plain REALs
+                    s = 10.0 ** schema[c].scale
+                    arrays.append([int(v) / s for v in arr])
                 elif arr.dtype == object:
                     arrays.append([str(v) for v in arr])
                 elif np.issubdtype(arr.dtype, np.floating):
@@ -100,7 +129,7 @@ class SqliteOracle:
 def _sqlite_type(t: Type) -> str:
     if t.is_string or t == DATE:
         return "TEXT"
-    if t.is_floating:
+    if t.is_floating or t.is_decimal:
         return "REAL"
     return "INTEGER"
 

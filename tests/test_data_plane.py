@@ -58,14 +58,18 @@ def test_tpch_generator_shapes(tpch_tiny):
 
 
 def test_tpch_orders_lineitem_consistency(tpch_tiny):
-    """o_totalprice must equal the sum over the order's lines."""
-    li, od = tpch_tiny["lineitem"], tpch_tiny["orders"]
+    """o_totalprice must equal the sum over the order's lines.
+
+    Money columns are DECIMAL(12,2) scaled-int64 lanes; unscale to compute."""
+    li, od = {k: v / 100.0 for k, v in tpch_tiny["lineitem"].items()
+              if k in ("l_extendedprice", "l_tax", "l_discount")}, tpch_tiny["orders"]
+    li["l_orderkey"] = tpch_tiny["lineitem"]["l_orderkey"]
     line_total = np.round(li["l_extendedprice"] * (1 + li["l_tax"]) * (1 - li["l_discount"]), 2)
     keys = {k: i for i, k in enumerate(od["o_orderkey"])}
     sums = np.zeros(len(od["o_orderkey"]))
     for k, v in zip(li["l_orderkey"], line_total):
         sums[keys[k]] += v
-    assert np.allclose(np.round(sums, 2), od["o_totalprice"], atol=0.05)
+    assert np.allclose(np.round(sums, 2), od["o_totalprice"] / 100.0, atol=0.05)
 
 
 def test_connector_splits(tpch_tiny):

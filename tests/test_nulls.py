@@ -143,15 +143,16 @@ def test_partition_page_routes_null_keys_to_part0():
     parts = partition_page(page, [FieldRef(0, BIGINT)], 4)
     # every NULL-key row must land in partition 0
     null_rows = 0
-    for p, blob in enumerate(parts):
-        cols = page_serde().deserialize_columns(blob)
-        v = cols.get("v0000")
-        if v is None:
-            continue
-        n_null = int((~v.astype(bool)).sum())
-        if p != 0:
-            assert n_null == 0, f"NULL-key row routed to partition {p}"
-        null_rows += n_null
+    for p, chunks in enumerate(parts):
+        for blob in chunks:
+            cols = page_serde().deserialize_columns(blob)
+            v = cols.get("v0000")
+            if v is None:
+                continue
+            n_null = int((~v.astype(bool)).sum())
+            if p != 0:
+                assert n_null == 0, f"NULL-key row routed to partition {p}"
+            null_rows += n_null
     assert null_rows == 3
 
 

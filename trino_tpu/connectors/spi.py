@@ -17,13 +17,59 @@ import numpy as np
 
 from ..data.types import Type
 
-__all__ = ["ColumnSchema", "TableSchema", "Split", "Connector", "CatalogManager"]
+__all__ = [
+    "ColumnSchema", "TableSchema", "Split", "Connector", "CatalogManager",
+    "ColumnStats", "TableStats", "compute_table_stats",
+]
 
 
 @dataclass(frozen=True)
 class ColumnSchema:
     name: str
     type: Type
+
+
+@dataclass(frozen=True)
+class ColumnStats:
+    """Reference: spi/statistics/ColumnStatistics (NDV, range, null fraction)
+    feeding the cost calculators (cost/FilterStatsCalculator, JoinStatsRule)."""
+
+    ndv: Optional[float] = None
+    min: Optional[float] = None  # numeric/date lanes only
+    max: Optional[float] = None
+    null_fraction: float = 0.0
+
+
+@dataclass(frozen=True)
+class TableStats:
+    row_count: float
+    columns: dict  # name -> ColumnStats
+
+
+def compute_table_stats(data: dict, max_ndv_rows: int = 50_000_000) -> TableStats:
+    """Exact stats from in-memory columns (generator/memory connectors).
+    NDV costs one numpy sort per column: numeric columns up to
+    max_ndv_rows, object (string) columns only below 4M rows."""
+    if not data:
+        return TableStats(0.0, {})
+    n = len(next(iter(data.values())))
+    cols = {}
+    for name, arr in data.items():
+        nulls = 0.0
+        base = arr
+        if isinstance(arr, np.ma.MaskedArray):
+            nulls = float(np.ma.getmaskarray(arr).sum()) / max(n, 1)
+            base = arr.compressed()
+        ndv = mn = mx = None
+        is_obj = base.dtype == object
+        ndv_cap = 4_000_000 if is_obj else max_ndv_rows
+        if len(base) and n <= ndv_cap:
+            ndv = float(len(np.unique(base)))
+        if len(base) and not is_obj and np.issubdtype(base.dtype, np.number):
+            mn = float(base.min())
+            mx = float(base.max())
+        cols[name] = ColumnStats(ndv, mn, mx, nulls)
+    return TableStats(float(n), cols)
 
 
 @dataclass(frozen=True)
@@ -80,6 +126,11 @@ class Connector(abc.ABC):
 
     def estimated_row_count(self, table: str) -> Optional[int]:
         """Optional stats for the cost-based optimizer."""
+        return None
+
+    def table_stats(self, table: str) -> Optional[TableStats]:
+        """Optional column-level stats (NDV/min/max/null fraction) for the
+        cost-based optimizer (reference: ConnectorMetadata.getTableStatistics)."""
         return None
 
 

@@ -14,6 +14,7 @@ __all__ = ["TpchConnector", "SCALE_TINY", "tpch_data"]
 # Module-level cache: (table, scale) -> column arrays.  Generation is
 # deterministic so caching is safe; tests and benches reuse the same data.
 _CACHE: dict[tuple[str, float], dict[str, np.ndarray]] = {}
+_STATS: dict[tuple[str, float], object] = {}
 
 
 def tpch_data(table: str, scale: float) -> dict[str, np.ndarray]:
@@ -57,3 +58,13 @@ class TpchConnector(Connector):
         from .generator import table_row_count
 
         return table_row_count(table, self.scale)
+
+    def table_stats(self, table: str):
+        """Exact column stats over the generated data (reference:
+        TpchMetadata.getTableStatistics serves precomputed stats)."""
+        key = (table, self.scale)
+        if key not in _STATS:
+            from ..spi import compute_table_stats
+
+            _STATS[key] = compute_table_stats(tpch_data(table, self.scale))
+        return _STATS[key]

@@ -10,8 +10,11 @@ vs sqlite over the *same* generated rows), so spec-exact dbgen bit-equality is
 not required; distribution shape is, because the 22 queries' selectivities
 depend on it.
 
-Column types follow the reference's default DOUBLE decimal mapping
-(plugin/trino-tpch TpchMetadata: DecimalTypeMapping.DOUBLE).
+Money/rate/quantity columns are DECIMAL(12,2), the spec types (the reference
+offers both mappings via plugin/trino-tpch TpchMetadata DecimalTypeMapping;
+here decimals are the default because scaled-int64 lanes are the only way a
+TPU — which computes "f64" at f32 — can honor SQL comparison boundaries and
+exact money sums).
 """
 
 from __future__ import annotations
@@ -20,7 +23,11 @@ import zlib
 
 import numpy as np
 
-from ...data.types import BIGINT, DATE, DOUBLE, INTEGER, VARCHAR, Type, date_to_days
+from ...data.types import BIGINT, DATE, DOUBLE, DecimalType, INTEGER, VARCHAR, Type, date_to_days
+
+# TPC-H money/rate/quantity columns are DECIMAL(12,2) per spec; scaled
+# int64 lanes make comparisons and sums exact on TPU (no native f64).
+MONEY = DecimalType(12, 2)
 
 __all__ = ["TPCH_SCHEMAS", "generate_table", "table_row_count", "SCALE_TINY"]
 
@@ -42,7 +49,7 @@ TPCH_SCHEMAS: dict[str, list[tuple[str, Type]]] = {
         ("s_address", VARCHAR),
         ("s_nationkey", BIGINT),
         ("s_phone", VARCHAR),
-        ("s_acctbal", DOUBLE),
+        ("s_acctbal", MONEY),
         ("s_comment", VARCHAR),
     ],
     "part": [
@@ -53,14 +60,14 @@ TPCH_SCHEMAS: dict[str, list[tuple[str, Type]]] = {
         ("p_type", VARCHAR),
         ("p_size", INTEGER),
         ("p_container", VARCHAR),
-        ("p_retailprice", DOUBLE),
+        ("p_retailprice", MONEY),
         ("p_comment", VARCHAR),
     ],
     "partsupp": [
         ("ps_partkey", BIGINT),
         ("ps_suppkey", BIGINT),
         ("ps_availqty", INTEGER),
-        ("ps_supplycost", DOUBLE),
+        ("ps_supplycost", MONEY),
         ("ps_comment", VARCHAR),
     ],
     "customer": [
@@ -69,7 +76,7 @@ TPCH_SCHEMAS: dict[str, list[tuple[str, Type]]] = {
         ("c_address", VARCHAR),
         ("c_nationkey", BIGINT),
         ("c_phone", VARCHAR),
-        ("c_acctbal", DOUBLE),
+        ("c_acctbal", MONEY),
         ("c_mktsegment", VARCHAR),
         ("c_comment", VARCHAR),
     ],
@@ -77,7 +84,7 @@ TPCH_SCHEMAS: dict[str, list[tuple[str, Type]]] = {
         ("o_orderkey", BIGINT),
         ("o_custkey", BIGINT),
         ("o_orderstatus", VARCHAR),
-        ("o_totalprice", DOUBLE),
+        ("o_totalprice", MONEY),
         ("o_orderdate", DATE),
         ("o_orderpriority", VARCHAR),
         ("o_clerk", VARCHAR),
@@ -89,10 +96,10 @@ TPCH_SCHEMAS: dict[str, list[tuple[str, Type]]] = {
         ("l_partkey", BIGINT),
         ("l_suppkey", BIGINT),
         ("l_linenumber", INTEGER),
-        ("l_quantity", DOUBLE),
-        ("l_extendedprice", DOUBLE),
-        ("l_discount", DOUBLE),
-        ("l_tax", DOUBLE),
+        ("l_quantity", MONEY),
+        ("l_extendedprice", MONEY),
+        ("l_discount", MONEY),
+        ("l_tax", MONEY),
         ("l_returnflag", VARCHAR),
         ("l_linestatus", VARCHAR),
         ("l_shipdate", DATE),
@@ -203,7 +210,11 @@ def _supp_for_part(partkey: np.ndarray, i: np.ndarray, num_supp: int, scale: flo
 
 
 def generate_table(table: str, scale: float) -> dict[str, np.ndarray]:
-    """Generate a full table as {column_name: numpy array} (object dtype for strings)."""
+    """Generate a full table as {column_name: numpy array} (object dtype for strings).
+
+    Money/quantity columns generate as f64 (exact multiples of 0.01 at these
+    magnitudes) and are scaled to DECIMAL(12,2) int64 lanes here, matching
+    the schema types."""
     fn = {
         "region": _gen_region,
         "nation": _gen_nation,
@@ -214,7 +225,13 @@ def generate_table(table: str, scale: float) -> dict[str, np.ndarray]:
         "orders": _gen_orders,
         "lineitem": _gen_lineitem,
     }[table]
-    return fn(scale)
+    data = fn(scale)
+    schema = dict(TPCH_SCHEMAS[table])
+    for c, arr in data.items():
+        t = schema[c]
+        if t.is_decimal and np.issubdtype(arr.dtype, np.floating):
+            data[c] = np.round(arr * (10.0**t.scale)).astype(np.int64)
+    return data
 
 
 def _gen_region(scale: float) -> dict[str, np.ndarray]:

@@ -38,11 +38,12 @@ class Dictionary:
     dictionaries are built once at ingest and shared by reference.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_hash64")
 
     def __init__(self, values: np.ndarray):
         self.values = np.asarray(values, dtype=object)
         self._index: Optional[dict] = None
+        self._hash64: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return len(self.values)
@@ -62,6 +63,32 @@ class Dictionary:
         dictionary-aware fast path (DictionaryAwarePageProjection.java).
         """
         return np.array([bool(predicate(v)) for v in self.values], dtype=np.bool_)
+
+    def hash64(self) -> np.ndarray:
+        """uint64 hash per dictionary VALUE (blake2b-8), computed once.
+
+        This is THE value-hash for strings: hash-partitioning (runtime/
+        wire.py), device repartition, and string-keyed joins (ops/relops.py
+        _combined_hash) must all route equal strings identically even when
+        their columns' code spaces differ — sharing this one table is what
+        guarantees it.  Always at least one entry (kernels gather from it)."""
+        if self._hash64 is None:
+            import hashlib
+
+            table = np.asarray(
+                [
+                    int.from_bytes(
+                        hashlib.blake2b(str(v).encode(), digest_size=8).digest(),
+                        "little",
+                    )
+                    for v in self.values
+                ],
+                dtype=np.uint64,
+            )
+            if len(table) == 0:
+                table = np.zeros((1,), dtype=np.uint64)
+            self._hash64 = table
+        return self._hash64
 
     def sorted_rank(self) -> np.ndarray:
         """rank[code] = rank of the value in sorted order, for ORDER BY."""
@@ -184,6 +211,10 @@ class Page:
                 pys.append(data.astype(bool))
             elif col.type.is_floating:
                 pys.append(data.astype(float))
+            elif col.type.is_decimal:
+                # scaled int64 -> float (result-set surface; int64/10^s is
+                # exact in f64 for short decimals)
+                pys.append(data.astype(np.int64) / (10.0 ** col.type.scale))
             else:
                 pys.append(data)
             valids.append(valid)

@@ -61,6 +61,10 @@ class Type:
         return self.is_integer or self.is_floating or self.name.startswith("decimal")
 
     @property
+    def is_decimal(self) -> bool:
+        return self.name.startswith("decimal")
+
+    @property
     def is_orderable(self) -> bool:
         return True
 
@@ -145,20 +149,38 @@ def parse_type(text: str) -> Type:
 
 def common_super_type(a: Type, b: Type) -> Type:
     """Implicit coercion lattice (reference: spi/type/TypeCoercion via
-    metadata; simplified to the numeric tower + identity)."""
+    metadata; simplified to the numeric tower + short decimals).
+
+    DECIMAL rules (reference: DecimalType + internal operator typing):
+    decimal+decimal widens to the max scale; decimal+integer treats the
+    integer as decimal(18,0); decimal+floating degrades to DOUBLE.
+    Precision is capped at 18 (scaled-int64 lanes; Int128 is future work).
+    """
     if a == b:
         return a
     if a == UNKNOWN:
         return b
     if b == UNKNOWN:
         return a
+    if a.is_decimal or b.is_decimal:
+        if a.is_floating or b.is_floating:
+            return DOUBLE
+        if a.is_integer:
+            a = DecimalType(18, 0)
+        if b.is_integer:
+            b = DecimalType(18, 0)
+        if a.is_decimal and b.is_decimal:
+            s = max(a.scale, b.scale)
+            p = min(18, max(a.precision - a.scale, b.precision - b.scale) + s + 1)
+            return DecimalType(p, s)
+        raise TypeError(f"no common type for {a} and {b}")
     order = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3, "real": 4, "double": 5}
     if a.name in order and b.name in order:
         # any integer + any float -> double; otherwise wider integer
         if a.is_floating or b.is_floating:
             return DOUBLE
         return a if order[a.name] >= order[b.name] else b
-    if a.is_numeric and b.is_numeric:  # decimals mix -> double (simplified)
+    if a.is_numeric and b.is_numeric:
         return DOUBLE
     if a.name == "date" and b.name == "varchar":
         return DATE

@@ -86,8 +86,18 @@ class LocalExecutor:
         # (0, 1) = whole table; worker tasks get their assigned split range
         # (reference: SplitAssignment in TaskUpdateRequest)
         self.split = (0, 1)
+        # pad every split to ceil(total/num_parts) rows (dead-tail mask) so
+        # ALL parts share one compiled program — the out-of-core executor
+        # iterates parts through a single jit cache entry this way
+        self.pad_splits = False
+        # dynamic filters: scan_node_id -> (ScanFilter, ...) applied host-side
+        # before upload (exec/dynfilter.py); rows outside the build-side key
+        # domain never cost HBM bandwidth or kernel lanes
+        self.scan_filters: dict = {}
+        self.rows_pruned = 0  # observability: dynamic-filter effectiveness
         self._table_cols: dict = {}
-        self._table_empty: dict = {}  # (catalog, table, gen, split) -> padded-empty?
+        self._table_pages: dict = {}  # page-object identity cache (CSE memo)
+        self._table_live: dict = {}  # (catalog, table, gen, split) -> live rows
         self._jit_cache: dict = {}
         # caps that completed a query without overflow, keyed by plan: repeat
         # executions skip the growth retries (the reference's runtime-adaptive
@@ -95,25 +105,39 @@ class LocalExecutor:
         self._learned_caps: dict[PlanNode, dict[int, int]] = {}
 
     # ------------------------------------------------------------- table IO
-    def table_page(self, catalog: str, table: str, columns: Sequence[str], types) -> Page:
+    def table_page(
+        self,
+        catalog: str,
+        table: str,
+        columns: Sequence[str],
+        types,
+        scan_id: Optional[int] = None,
+    ) -> Page:
         """Device page for the pruned column set; columns are materialized and
         uploaded lazily, once each (the scan-level projection pushdown the
-        reference does via ConnectorPageSource lazy blocks)."""
+        reference does via ConnectorPageSource lazy blocks).  scan_id scopes
+        dynamic filters to THIS scan site (exec/dynfilter.py) and is part of
+        the cache key so filtered and unfiltered sites never share columns."""
         conn = self.catalogs.get(catalog)
         schema = conn.table_schema(table)
         gen = getattr(conn, "generation", 0)  # writable connectors bump this
-        key_of = lambda c: (catalog, table, c, gen, self.split)
+        filters = self.scan_filters.get(scan_id, ()) if scan_id is not None else ()
+        key_of = lambda c: (catalog, table, c, gen, self.split, filters)
+        live_key = (catalog, table, gen, self.split, filters)
         missing = [c for c in columns if key_of(c) not in self._table_cols]
         if missing:
             part, num_parts = self.split
+            want = list(missing) + [
+                f.column for f in filters if f.column not in missing
+            ]
             splits = [
                 s
                 for i, s in enumerate(conn.get_splits(table, num_parts))
                 if i % num_parts == part or num_parts == 1
             ]
-            data = conn.read_split(splits[0], missing)
+            data = conn.read_split(splits[0], want)
             for s in splits[1:]:
-                more = conn.read_split(s, missing)
+                more = conn.read_split(s, want)
                 data = {
                     c: (
                         np.ma.concatenate([data[c], more[c]])
@@ -121,22 +145,66 @@ class LocalExecutor:
                         or isinstance(more[c], np.ma.MaskedArray)
                         else np.concatenate([data[c], more[c]])
                     )
-                    for c in missing
+                    for c in want
                 }
+            if filters:
+                nrows = len(next(iter(data.values()))) if data else 0
+                keep = np.ones((nrows,), dtype=bool)
+                for f in filters:
+                    vals = data[f.column]
+                    if isinstance(vals, np.ma.MaskedArray):
+                        # NULL probe keys never equi-match: prune them too
+                        ok = (vals >= f.min) & (vals <= f.max)
+                        keep &= np.asarray(ok.filled(False))
+                    else:
+                        keep &= (vals >= f.min) & (vals <= f.max)
+                self.rows_pruned += int(nrows - keep.sum())
+                data = {c: data[c][keep] for c in missing}
+            pad_to = 1  # kernels need capacity >= 1
+            if filters:
+                # pruned capacity varies run to run: pow2 padding keeps the
+                # compiled-shape count logarithmic
+                n_after = len(next(iter(data.values()))) if data else 0
+                pad_to = 1 << max(0, (n_after - 1).bit_length())
+            if self.pad_splits and num_parts > 1 and not filters:
+                total = conn.estimated_row_count(table)
+                if total:
+                    pad_to = max(1, -(-int(total) // num_parts))
             for c in missing:
                 arr = data[c]
-                if len(arr) == 0:  # kernels need capacity >= 1: pad one dead row
+                n_live = len(arr)
+                if n_live < pad_to:
                     t = schema.type_of(c)
-                    arr = np.zeros((1,), dtype=object if t.is_string else t.np_dtype)
+                    fill = np.zeros(
+                        (pad_to - n_live,), dtype=object if t.is_string else t.np_dtype
+                    )
                     if t.is_string:
-                        arr[0] = ""
-                    self._table_empty[(catalog, table, gen, self.split)] = True
+                        fill[:] = ""
+                    if isinstance(arr, np.ma.MaskedArray):
+                        arr = np.ma.concatenate(
+                            [arr, np.ma.MaskedArray(fill, mask=True)]
+                        )
+                    else:
+                        arr = np.concatenate([arr, fill]) if n_live else fill
+                    self._table_live[live_key] = n_live
                 self._table_cols[key_of(c)] = Column.from_numpy(schema.type_of(c), arr)
+        page_key = (catalog, table, tuple(columns), gen, self.split, filters)
+        cached = self._table_pages.get(page_key)
+        if cached is not None:
+            return cached
         cols = tuple(self._table_cols[key_of(c)] for c in columns)
         live = None
-        if self._table_empty.get((catalog, table, gen, self.split)):
-            live = jnp.zeros((cols[0].capacity if cols else 1,), jnp.bool_)
-        return Page(cols, live)
+        n_live = self._table_live.get(live_key)
+        if n_live is not None:
+            cap = cols[0].capacity if cols else 1
+            live = jnp.arange(cap, dtype=jnp.int32) < n_live
+        page = Page(cols, live)
+        # identical scan sites get the IDENTICAL Page object: _trace_plan's
+        # structural-CSE memo validates reuse by page identity, so two
+        # unfiltered scans of the same table CSE while a dynamically-filtered
+        # site (different `filters` key -> different object) never does
+        self._table_pages[page_key] = page
+        return page
 
     # ------------------------------------------------------------ execution
     def execute(
@@ -149,7 +217,7 @@ class LocalExecutor:
         for i, n in nodes.items():
             if isinstance(n, TableScan):
                 inputs[str(i)] = self.table_page(
-                    n.catalog, n.table, n.column_names, n.output_types
+                    n.catalog, n.table, n.column_names, n.output_types, scan_id=i
                 )
             elif isinstance(n, RemoteSource):
                 inputs[str(i)] = remote_pages[n.fragment_id]
@@ -193,9 +261,19 @@ class LocalExecutor:
         return self.execute(plan).to_pylist()
 
     def _initial_caps(self, nodes, inputs) -> dict[int, int]:
-        # conservative first guesses; the retry loop corrects upward
+        # stats-fed first guesses (plan/stats.py: group-key NDV products,
+        # join fan-out); the retry loop corrects upward when stats are off.
+        # This replaces round 1's blind 65536 clamp, whose guaranteed
+        # retries recompiled whole fragments on high-cardinality group-bys.
+        from ..plan.stats import estimate as _est
+
         caps: dict[int, int] = {}
-        sizes: dict[int, int] = {}
+
+        def est_groups(n: PlanNode) -> Optional[int]:
+            try:
+                return int(_est(n, self.catalogs).rows * 1.3) + 16
+            except Exception:
+                return None
 
         def size_of(nid: int, n: PlanNode) -> int:
             if isinstance(n, (TableScan, RemoteSource)):
@@ -203,9 +281,9 @@ class LocalExecutor:
             child_ids = _child_ids(nodes, nid)
             child_sizes = [size_of(c, nodes[c]) for c in child_ids]
             if isinstance(n, (Aggregate, Distinct)):
-                # optimistic: most group-bys collapse hard; the retry loop
-                # (with the learned-caps memo) corrects high-cardinality ones
-                caps[nid] = min(_pow2(max(child_sizes[0], 1)), 65536)
+                hint = est_groups(n)
+                cap = hint if hint is not None else 65536
+                caps[nid] = min(_pow2(max(cap, 1024)), _pow2(max(child_sizes[0], 1)))
                 return caps[nid]
             if isinstance(n, Join):
                 if n.kind in ("semi", "anti", "null_anti"):
@@ -218,11 +296,51 @@ class LocalExecutor:
                     return caps[nid] + child_sizes[0]
                 return caps[nid]
             if isinstance(n, TopN):
+                # radix-select candidate buffer (ops/relops.py top_n): room
+                # for K plus boundary ties; sort fallback never overflows it
+                caps[nid] = min(_pow2(2 * n.count + 512), _pow2(max(child_sizes[0], 1)))
                 return min(n.count, child_sizes[0])
             return child_sizes[0]
 
         size_of(0, nodes[0])
         return caps
+
+    def explain_analyze(self, plan: PlanNode) -> tuple[Page, dict]:
+        """Execute with per-operator observability (the reference's
+        OperatorStats rolled up by ExplainAnalyzeOperator).
+
+        Returns (page, stats) where stats[nid] = {"rows": int, "ms": float}.
+        Per-operator wall time comes from an eager pass with a block-until-
+        ready hook after every node — dispatch overhead inflates absolute
+        numbers, but relative attribution identifies the slow operator; the
+        row counts come from the jitted run and are exact."""
+        import time
+
+        # ensure capacities are learned + result correct (jitted path)
+        page = self.execute(plan)
+        caps = self._learned_caps[plan]
+        nodes = _node_ids(plan)
+        inputs = {}
+        for i, n in nodes.items():
+            if isinstance(n, TableScan):
+                inputs[str(i)] = self.table_page(
+                    n.catalog, n.table, n.column_names, n.output_types, scan_id=i
+                )
+        stats: dict[int, dict] = {}
+
+        last = [time.perf_counter()]
+
+        def hook(nid, node, stage):
+            jax.block_until_ready(stage.live)
+            now = time.perf_counter()
+            stats[nid] = {"ms": (now - last[0]) * 1e3}
+            last[0] = now
+
+        _, required = _trace_plan(plan, inputs, caps, node_hook=hook, collect_stats=True)
+        for key, val in required.items():
+            if isinstance(key, tuple) and key[0] == "rows":
+                stats.setdefault(key[1], {})["rows"] = int(val)
+        return page, stats
 
     def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
         cache_key = (plan, tuple(sorted(caps.items())),
@@ -251,10 +369,19 @@ def _trace_plan(
     caps: dict[int, int],
     num_devices: int = 1,
     axis: Optional[str] = None,
+    collect_stats: bool = False,
+    node_hook=None,
 ):
     """Trace a plan into jax ops.  With `axis` set, the trace happens inside
     shard_map and Exchange nodes lower to collectives (parallel/exchange.py);
-    overflow counters are pmax-reduced so every device agrees on retries."""
+    overflow counters are pmax-reduced so every device agrees on retries.
+
+    collect_stats: also report each node's live output-row count under the
+    key ("rows", nid) in `required` — the per-operator row stats EXPLAIN
+    ANALYZE renders (reference: OperatorStats via OperatorContext).
+    node_hook(nid, node, stage): called after each node emits; in eager
+    (non-jit) execution the hook can block_until_ready for wall-clock
+    attribution per operator."""
     required: dict[int, jnp.ndarray] = {}
     counter = [0]
     # Structural CSE: a WITH clause referenced twice plans as two structurally
@@ -263,14 +390,23 @@ def _trace_plan(
     # optimizer plan-node sharing; here frozen-dataclass equality is the memo
     # key.  Node-id numbering stays in pre-order, so on reuse the counter
     # skips the subtree's id range.
-    memo: dict[PlanNode, "_Stage"] = {}
+    memo: dict[PlanNode, tuple["_Stage", tuple[int, ...], int]] = {}
 
     def report(nid: int, value):
         if axis is not None:
             value = jax.lax.pmax(value, axis)
         required[nid] = value
 
+    def _scan_offsets(node: PlanNode) -> tuple[int, ...]:
+        # pre-order offsets of the leaf nodes that read pages[str(nid)]
+        return tuple(
+            off
+            for off, n in enumerate(_node_ids(node).values())
+            if isinstance(n, (TableScan, RemoteSource))
+        )
+
     def emit(node: PlanNode) -> _Stage:
+        nid_here = counter[0]
         try:
             cached = memo.get(node)
         except TypeError:  # unhashable payload somewhere; trace normally
@@ -279,14 +415,26 @@ def _trace_plan(
         else:
             hashable = True
         if cached is not None:
-            counter[0] += len(_node_ids(node))
-            return _Stage(
-                [ColumnVal(cv.data, cv.valid, cv.dict, cv.type) for cv in cached.cols],
-                cached.live,
-            )
+            stage_c, offsets, orig_nid = cached
+            # reuse is only sound if this site reads the SAME page objects:
+            # dynamic filters (exec/dynfilter.py) prune scans per site, so a
+            # structurally identical scan can carry different rows here
+            if all(
+                pages.get(str(nid_here + off)) is pages.get(str(orig_nid + off))
+                for off in offsets
+            ):
+                counter[0] += len(_node_ids(node))
+                return _Stage(
+                    [ColumnVal(cv.data, cv.valid, cv.dict, cv.type) for cv in stage_c.cols],
+                    stage_c.live,
+                )
         stage = _emit(node)
         if hashable:
-            memo[node] = stage
+            memo[node] = (stage, _scan_offsets(node), nid_here)
+        if collect_stats:
+            required[("rows", nid_here)] = jnp.sum(stage.live.astype(jnp.int64))
+        if node_hook is not None:
+            node_hook(nid_here, node, stage)
         return stage
 
     def _emit(node: PlanNode) -> _Stage:
@@ -381,7 +529,10 @@ def _trace_plan(
             s = emit(node.child)
             keys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.keys]
             specs = [SortSpec(k.ascending, k.nulls_first) for k in node.keys]
-            cols, live = top_n(s.cols, s.live, keys, specs, node.count)
+            cols, live, req = top_n(
+                s.cols, s.live, keys, specs, node.count, caps.get(nid)
+            )
+            report(nid, req)
             return _Stage(cols, live)
 
         if isinstance(node, Limit):

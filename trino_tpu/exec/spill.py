@@ -1,0 +1,172 @@
+"""Out-of-core partitioned execution: spill tiers for working sets > HBM.
+
+Reference: the revocable-memory + spill complex —
+spiller/FileSingleStreamSpiller.java:59 (serialized pages to local disk),
+SpillableHashAggregationBuilder.java:55 (aggregation partitions spill and
+merge), HashBuilderOperator.java:167 (join build spill states, partition-
+at-a-time unspilling).
+
+TPU-native shape: out-of-core is TIME-MULTIPLEXED DISTRIBUTED EXECUTION.
+The distributed planner (plan/distribute.py) already rewrites any plan into
+P hash-partitioned fragments whose exchanges are disjoint by key; the SPMD
+executor runs those P shards on P chips in parallel — this executor runs
+the SAME plan's fragments on ONE chip sequentially, parking every exchange
+buffer on disk (zstd-compressed wire pages via the C++ serde,
+trino_tpu/native) between stages.  One chip's HBM only ever holds 1/P of
+each stage's working set, so any state that fits on disk completes:
+
+    parallel across chips  ==  sequential across time slices
+    ICI all_to_all         ==  spill-file shuffle on local disk
+
+Partition count P is chosen from the memory estimate vs the query budget
+(runtime/memory.py) — the analogue of the reference's
+ExponentialGrowthPartitionMemoryEstimator picking bigger nodes on retry.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+from ..connectors.spi import CatalogManager
+from ..data.page import Page
+from ..plan.distribute import distribute
+from ..plan.fragmenter import fragment_plan
+from ..plan.nodes import PlanNode, TableScan
+from ..runtime.wire import partition_page, page_to_wire_chunks, wire_to_page
+from .compiler import LocalExecutor, _node_ids
+
+__all__ = ["OutOfCoreExecutor", "estimate_plan_bytes"]
+
+
+def estimate_plan_bytes(plan: PlanNode, catalogs: CatalogManager) -> int:
+    """Upper-bound estimate of device bytes for single-shot execution:
+    scanned column bytes plus the same again for operator state (join
+    expansion frames, group-by capacities are bounded by input size for
+    TPC-class plans; a 2x factor covers gathered intermediates)."""
+    total = 0
+    for _, n in _node_ids(plan).items():
+        if isinstance(n, TableScan):
+            conn = catalogs.get(n.catalog)
+            rows = conn.estimated_row_count(n.table) or 0
+            width = 0
+            for t in n.output_types:
+                width += 4 if t.is_string else t.np_dtype.itemsize
+            total += rows * width
+    return total * 2
+
+
+class OutOfCoreExecutor:
+    """Executes a logical plan in P sequential hash-partitioned slices with
+    disk-backed exchanges.  API-compatible with LocalExecutor.execute for
+    the engine's read path."""
+
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        default_catalog: str,
+        parts: int,
+        session=None,
+        spill_dir: Optional[str] = None,
+    ):
+        self.catalogs = catalogs
+        self.default_catalog = default_catalog
+        self.parts = max(2, parts)
+        self.session = session
+        self.spill_dir = spill_dir
+        self.spilled_bytes = 0
+        self.spill_files = 0
+
+    def execute(self, plan: PlanNode) -> Page:
+        parts = self.parts
+        dplan = distribute(plan, self.catalogs, parts, self.session)
+        fragments = fragment_plan(dplan)
+        frag_by_id = {f.id: f for f in fragments}
+        ntasks = {f.id: (1 if f.output_kind == "result" else parts) for f in fragments}
+        consumer_of = {}
+        for f in fragments:
+            for child in f.inputs:
+                consumer_of[child] = f.id
+
+        tmp = self.spill_dir or tempfile.mkdtemp(prefix="trino_tpu_spill_")
+        own_tmp = self.spill_dir is None
+        # (frag_id, producer_part, out_partition) -> list of chunk files
+        spill: dict[tuple[int, int, int], list[str]] = {}
+        seq = [0]
+
+        def write_chunks(key, chunks: list[bytes]) -> None:
+            paths = []
+            for blob in chunks:
+                path = os.path.join(tmp, f"s{seq[0]}.page")
+                seq[0] += 1
+                with open(path, "wb") as fh:
+                    fh.write(blob)
+                self.spilled_bytes += len(blob)
+                self.spill_files += 1
+                paths.append(path)
+            spill[key] = paths
+
+        def read_blobs(keys) -> list[bytes]:
+            out = []
+            for k in keys:
+                for path in spill.get(k, []):
+                    with open(path, "rb") as fh:
+                        out.append(fh.read())
+            return out
+
+        try:
+            for f in sorted(fragments, key=lambda fr: -fr.id):
+                if f.output_kind == "result":
+                    continue
+                out_parts = ntasks[consumer_of[f.id]]
+                # ONE executor per fragment with uniform split padding: every
+                # slice shares the same compiled program and learned
+                # capacities; the table-column cache is dropped between
+                # slices so HBM only holds one slice's working set
+                ex = LocalExecutor(self.catalogs, self.default_catalog)
+                ex.pad_splits = True
+                for p in range(ntasks[f.id]):
+                    ex.split = (p, ntasks[f.id])
+                    ex._table_cols.clear()
+                    ex._table_live.clear()
+                    remote = self._sources(f, frag_by_id, ntasks, p, read_blobs)
+                    from .dynfilter import collect_dynamic_filters
+
+                    ex.scan_filters = collect_dynamic_filters(f.root, remote)
+                    self.rows_pruned = getattr(self, "rows_pruned", 0)
+                    page = ex.execute(f.root, remote)
+                    self.rows_pruned += ex.rows_pruned
+                    ex.rows_pruned = 0
+                    if f.output_kind == "repartition":
+                        chunk_lists = partition_page(page, list(f.output_keys), out_parts)
+                        for op, chunks in enumerate(chunk_lists):
+                            write_chunks((f.id, p, op), chunks)
+                    else:
+                        write_chunks((f.id, p, 0), page_to_wire_chunks(page))
+
+            root = frag_by_id[0]
+            ex = LocalExecutor(self.catalogs, self.default_catalog)
+            remote = self._sources(root, frag_by_id, ntasks, 0, read_blobs)
+            return ex.execute(root.root, remote)
+        finally:
+            if own_tmp:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    def _sources(self, f, frag_by_id, ntasks, my_part, read_blobs) -> dict[int, Page]:
+        remote: dict[int, Page] = {}
+        for child_id in f.inputs:
+            child = frag_by_id[child_id]
+            kind = child.output_kind
+            nprod = ntasks[child_id]
+            if kind == "single" and my_part != 0:
+                blobs = []
+            elif kind == "repartition":
+                blobs = read_blobs([(child_id, p, my_part) for p in range(nprod)])
+            else:  # gather / broadcast / single
+                blobs = read_blobs([(child_id, p, 0) for p in range(nprod)])
+            remote[child_id] = wire_to_page(
+                blobs, list(child.root.output_types), pad_pow2=True
+            )
+        return remote

@@ -254,6 +254,29 @@ def _cast(a: ColumnVal, target: Type, n: int) -> ColumnVal:
         return a
     if target == VARCHAR:
         raise NotImplementedError("cast to varchar")
+    # DECIMAL rescaling on int64 lanes (reference: spi/type/DecimalConversions
+    # — rescale by powers of ten, round half away from zero when narrowing)
+    if target.is_decimal or (a.type is not None and a.type.is_decimal):
+        src_scale = a.type.scale if (a.type is not None and a.type.is_decimal) else 0
+        if target.is_decimal:
+            if a.type is not None and a.type.is_floating:
+                data = jnp.round(a.data.astype(jnp.float64) * (10.0**target.scale))
+                return ColumnVal(data.astype(jnp.int64), a.valid, None, target)
+            d = a.data.astype(jnp.int64)
+            if target.scale >= src_scale:
+                out = d * (10 ** (target.scale - src_scale))
+            else:
+                div = 10 ** (src_scale - target.scale)
+                out = jnp.sign(d) * ((jnp.abs(d) + div // 2) // div)
+            return ColumnVal(out, a.valid, None, target)
+        # decimal source -> non-decimal target
+        d = a.data.astype(jnp.int64)
+        if target.is_floating:
+            out = d.astype(jnp.float64) / (10.0**src_scale)
+            return ColumnVal(out.astype(_np_to_jnp(target)), a.valid, None, target)
+        div = 10**src_scale
+        out = jnp.sign(d) * ((jnp.abs(d) + div // 2) // div)
+        return ColumnVal(out.astype(_np_to_jnp(target)), a.valid, None, target)
     if a.dict is not None:
         # varchar -> numeric/date via host parse of dictionary values
         if target == DATE:

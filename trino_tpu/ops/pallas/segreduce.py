@@ -1,0 +1,513 @@
+"""Fused segmented-reduction Pallas kernel (TPU group-by accumulator).
+
+The reference aggregates through FlatHash — a Swiss-table whose SWAR probe
+touches 8 control bytes per key (operator/FlatHash.java:38,59) — then runs
+per-function Accumulators over the grouped rows (operator/aggregation/).
+Per-row hash probing is the wrong shape for a TPU: the VPU wants 8x128
+lanes of straight-line math and the MXU wants matmuls.
+
+This kernel is the TPU-native replacement for the *accumulation* phase:
+given a segment id per row (from the dictionary-code fast path or the
+sort-based grouping in ops/relops.py), it computes EVERY aggregate of the
+GROUP BY in ONE pass over HBM:
+
+- all SUM/COUNT/AVG columns ride the MXU as one-hot matmuls:
+  partial[a, g] = sum_k vals[a, k] * (seg[k] == g).  With
+  ``precision=HIGHEST`` the bf16x6 decomposition makes integer-valued f32
+  products EXACT, so the same matmul path serves both float sums and the
+  limb-decomposed exact-integer sums below.
+- float (DOUBLE) sums use Kahan/Neumaier compensation across row-chunks:
+  TwoSum residuals accumulate in a second f32 buffer, recovering ~2x f32
+  mantissa — on TPU hardware (no native f64) this is *more* accurate than
+  the jnp.float64 the XLA path pretends to have (it silently computes f32).
+- BIGINT sums are bit-exact: the host decomposes each value into signed
+  14-bit limbs (f32-exact products; 1024-row chunk partials stay < 2^24),
+  the kernel accumulates limbs in int32 with a carry-propagation sweep
+  every 32 chunks, and the host recombines limbs in int64.
+- MIN/MAX reduce on the VPU against the same one-hot mask, fused into the
+  same HBM pass.
+
+Grid = row chunks of 1024 (8 sublanes x 128 lanes); group axis is tiled by
+512 lanes so the one-hot stays ~2MB of VMEM; accumulators live in VMEM
+scratch across the (sequential) TPU grid.  Practical ceiling is G ≈ 8192
+groups — beyond that the n*G one-hot work dominates and the sort-based
+path in ops/relops.py wins.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SegRed", "fused_segment_reduce", "pallas_segreduce_supported"]
+
+_CHUNK_S = 8  # sublanes per row-chunk
+_CHUNK_L = 128  # lanes per row-chunk
+_CHUNK = _CHUNK_S * _CHUNK_L  # 1024 rows per grid step
+_GTILE = 512  # group-axis tile (lanes)
+_LIMB_BITS = 14  # 1024 rows * (2^14-1) < 2^24: chunk partials f32-exact
+_CARRY_EVERY = 32  # 32 * 2^24 < 2^31: int32 accumulators never overflow
+_MAX_GROUPS = 8192  # beyond this the n*G one-hot work loses to sorting
+
+_SUM_EXACT_MAX_F32 = float(1 << 24)  # ints this small sum exactly per chunk
+
+# Test hook: force the Pallas path (in interpreter mode) even on CPU so the
+# kernel itself — not just the XLA fallback — is exercised by the suite.
+INTERPRET = False
+
+
+@dataclass(frozen=True)
+class SegRed:
+    """One requested reduction over the segmented rows.
+
+    op: 'sum' | 'min' | 'max' | 'count'  ('count' == sum of valid 0/1)
+    values: [n] array (ignored for 'count' when valid is given)
+    valid: optional [n] bool — rows where the argument is non-NULL and live.
+    """
+
+    op: str
+    values: Optional[jnp.ndarray]
+    valid: Optional[jnp.ndarray]
+
+
+def pallas_segreduce_supported(num_segments: int, backend: Optional[str] = None) -> bool:
+    if num_segments > _MAX_GROUPS:
+        return False
+    return (backend or jax.default_backend()) in ("tpu", "axon")
+
+
+# --------------------------------------------------------------------------
+# kernel factory (cached per static config)
+# --------------------------------------------------------------------------
+
+
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
+_I32_MIN = np.int32(np.iinfo(np.int32).min)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_kernel(
+    n_chunks: int,
+    af: int,  # kahan f32 sum columns
+    ai: int,  # exact int32-accumulated columns
+    amn: int,  # f32 min columns
+    amx: int,  # f32 max columns
+    imn: int,  # native-i32 min columns (exact: dates, dict ranks, INTEGER)
+    imx: int,  # native-i32 max columns
+    g_pad: int,
+    carry_groups: tuple,  # ((start, n_limbs), ...) within the ai block
+    interpret: bool,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles = g_pad // _GTILE
+    gt = _GTILE
+    hi = jax.lax.Precision.HIGHEST
+
+    # scratch rows must satisfy the (8, 128) tile constraint
+    def pad8(k):
+        return max(8, -(-k // 8) * 8)
+
+    counts = (af, ai, amn, amx, imn, imx)
+
+    def kernel(*refs):
+        it = iter(refs)
+        seg_ref = next(it)
+        f_ref, i_ref, mn_ref, mx_ref, imn_ref, imx_ref = (
+            next(it) if k else None for k in counts
+        )
+        of_ref, oi_ref, omn_ref, omx_ref, oimn_ref, oimx_ref = (
+            next(it) if k else None for k in counts
+        )
+        facc = next(it) if af else None
+        ferr = next(it) if af else None
+        iacc = next(it) if ai else None
+        mnacc = next(it) if amn else None
+        mxacc = next(it) if amx else None
+        imnacc = next(it) if imn else None
+        imxacc = next(it) if imx else None
+
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            if af:
+                facc[:] = jnp.zeros_like(facc)
+                ferr[:] = jnp.zeros_like(ferr)
+            if ai:
+                iacc[:] = jnp.zeros_like(iacc)
+            if amn:
+                mnacc[:] = jnp.full_like(mnacc, jnp.inf)
+            if amx:
+                mxacc[:] = jnp.full_like(mxacc, -jnp.inf)
+            if imn:
+                imnacc[:] = jnp.full_like(imnacc, _I32_MAX)
+            if imx:
+                imxacc[:] = jnp.full_like(imxacc, _I32_MIN)
+
+        sg = seg_ref[:]  # [S, L] int32
+        fvt = jnp.transpose(f_ref[:], (1, 0, 2)) if af else None  # [S, af, L]
+        ivt = jnp.transpose(i_ref[:], (1, 0, 2)) if ai else None
+
+        def mm_pass(ref, acc, k, mask, sl, reduce, sentinel):
+            v = ref[:]
+            for a in range(k):
+                big = jnp.where(mask, v[a][:, :, None], sentinel)
+                cur = reduce(big, axis=(0, 1)).reshape(1, gt)
+                merge = jnp.minimum if reduce is jnp.min else jnp.maximum
+                acc[a : a + 1, sl] = merge(acc[a : a + 1, sl], cur)
+
+        for t in range(n_tiles):
+            base = t * gt
+            iota = jax.lax.broadcasted_iota(jnp.int32, (_CHUNK_S, _CHUNK_L, gt), 2)
+            mask = sg[:, :, None] == (iota + base)
+            oh = mask.astype(jnp.float32)
+            sl = slice(base, base + gt)
+
+            if af:
+                part = jax.lax.dot_general(
+                    fvt, oh, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32, precision=hi,
+                )  # [S, af, gt]
+                p = jnp.sum(part, axis=0)
+                # Neumaier TwoSum: a + p == s + e exactly
+                a = facc[0:af, sl]
+                s = a + p
+                e = jnp.where(jnp.abs(a) >= jnp.abs(p), (a - s) + p, (p - s) + a)
+                facc[0:af, sl] = s
+                ferr[0:af, sl] += e
+
+            if ai:
+                part = jax.lax.dot_general(
+                    ivt, oh, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32, precision=hi,
+                )
+                iacc[0:ai, sl] += jnp.sum(part, axis=0).astype(jnp.int32)
+
+            if amn:
+                mm_pass(mn_ref, mnacc, amn, mask, sl, jnp.min, jnp.float32(jnp.inf))
+            if amx:
+                mm_pass(mx_ref, mxacc, amx, mask, sl, jnp.max, jnp.float32(-jnp.inf))
+            if imn:
+                mm_pass(imn_ref, imnacc, imn, mask, sl, jnp.min, _I32_MAX)
+            if imx:
+                mm_pass(imx_ref, imxacc, imx, mask, sl, jnp.max, _I32_MIN)
+
+        if carry_groups:
+
+            @pl.when((i & (_CARRY_EVERY - 1)) == (_CARRY_EVERY - 1))
+            def _carry():
+                for (start, nl) in carry_groups:
+                    for l in range(nl - 1):
+                        row = iacc[start + l : start + l + 1, :]
+                        c = row >> _LIMB_BITS
+                        iacc[start + l : start + l + 1, :] = row - (c << _LIMB_BITS)
+                        iacc[start + l + 1 : start + l + 2, :] += c
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _fin():
+            if af:
+                # acc and err are returned separately: adding them in f32
+                # would re-round and discard the compensation — the host
+                # combines them in f64.
+                of_ref[0:af, :] = facc[0:af, :]
+                of_ref[af : 2 * af, :] = ferr[0:af, :]
+            if ai:
+                oi_ref[:] = iacc[0:ai, :]
+            if amn:
+                omn_ref[:] = mnacc[0:amn, :]
+            if amx:
+                omx_ref[:] = mxacc[0:amx, :]
+            if imn:
+                oimn_ref[:] = imnacc[0:imn, :]
+            if imx:
+                oimx_ref[:] = imxacc[0:imx, :]
+
+    vmem = pltpu.VMEM
+    in_specs = [pl.BlockSpec((_CHUNK_S, _CHUNK_L), lambda i: (i, 0), memory_space=vmem)]
+    out_specs, out_shape, scratch = [], [], []
+    for k in counts:
+        if k:
+            in_specs.append(
+                pl.BlockSpec((k, _CHUNK_S, _CHUNK_L), lambda i: (0, i, 0), memory_space=vmem)
+            )
+    out_cfg = (
+        (2 * af, jnp.float32),
+        (ai, jnp.int32),
+        (amn, jnp.float32),
+        (amx, jnp.float32),
+        (imn, jnp.int32),
+        (imx, jnp.int32),
+    )
+    for k, dt in out_cfg:
+        if k:
+            out_specs.append(pl.BlockSpec((k, g_pad), lambda i: (0, 0), memory_space=vmem))
+            out_shape.append(jax.ShapeDtypeStruct((k, g_pad), dt))
+    if af:
+        scratch += [pltpu.VMEM((pad8(af), g_pad), jnp.float32)] * 2
+    if ai:
+        scratch.append(pltpu.VMEM((pad8(ai), g_pad), jnp.int32))
+    for k, dt in ((amn, jnp.float32), (amx, jnp.float32), (imn, jnp.int32), (imx, jnp.int32)):
+        if k:
+            scratch.append(pltpu.VMEM((pad8(k), g_pad), dt))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )
+
+
+def _limbs_for(dtype) -> int:
+    if dtype in (jnp.int64, np.int64):
+        return 5  # 70 bits
+    return 3  # int32/date: 42 bits
+
+
+def _prep_rows(arr: jnp.ndarray, n_pad: int, fill) -> jnp.ndarray:
+    out = jnp.pad(arr, (0, n_pad - arr.shape[0]), constant_values=fill)
+    return out.reshape(n_pad // _CHUNK_L, _CHUNK_L)
+
+
+def fused_segment_reduce(
+    seg: jnp.ndarray,
+    reds: Sequence[SegRed],
+    num_segments: int,
+    *,
+    interpret: bool = False,
+    force_pallas: bool = False,
+) -> list[jnp.ndarray]:
+    """Compute every requested reduction in one fused pass.
+
+    seg: [n] int32 segment ids in [0, num_segments); rows with seg >=
+    num_segments (the caller's dead-lane convention) fall into padding
+    groups and are sliced off.
+
+    Returns one array [num_segments] per red:
+      sum of floats  -> float64 (Kahan-compensated on the Pallas path)
+      sum of ints    -> int64, bit-exact
+      count          -> int64
+      min/max        -> the input dtype
+    Empty groups yield 0 for sum/count and +inf/-inf (or dtype extrema)
+    for min/max; the caller masks them with its count column.
+    """
+    n = seg.shape[0]
+    G = num_segments
+    interpret = interpret or INTERPRET
+    use_pallas = force_pallas or interpret or pallas_segreduce_supported(G)
+    if not use_pallas:
+        return _xla_fallback(seg, reds, G)
+
+    g_pad = max(_GTILE, -(-(G + 1) // _GTILE) * _GTILE)
+    n_pad = -(-n // _CHUNK) * _CHUNK
+    n_chunks = n_pad // _CHUNK
+
+    seg_c = jnp.clip(seg.astype(jnp.int32), 0, g_pad - 1)
+    seg_c = jnp.where(seg.astype(jnp.int32) >= G, g_pad - 1, seg_c)
+    seg2 = _prep_rows(seg_c, n_pad, g_pad - 1)
+
+    f_cols: list[jnp.ndarray] = []  # kahan f32 sum columns
+    i_cols: list[jnp.ndarray] = []  # exact i32-accumulated columns
+    mn_cols: list[jnp.ndarray] = []  # f32 min
+    mx_cols: list[jnp.ndarray] = []  # f32 max
+    imn_cols: list[jnp.ndarray] = []  # exact i32 min
+    imx_cols: list[jnp.ndarray] = []  # exact i32 max
+    carry_groups: list[tuple[int, int]] = []
+    plan: list[tuple] = []  # (kind, payload) per red, to unpack outputs
+    xla_reds: list[tuple[int, SegRed]] = []  # kernel-ineligible (int64 min/max)
+
+    def _i32_ok(dtype) -> bool:
+        return dtype in (jnp.int32, np.dtype(np.int32), jnp.int16, jnp.int8,
+                         np.dtype(np.int16), np.dtype(np.int8), jnp.bool_,
+                         np.dtype(np.bool_))
+
+    for ri, r in enumerate(reds):
+        if r.op == "count":
+            v = (
+                r.valid.astype(jnp.float32)
+                if r.valid is not None
+                else jnp.ones((n,), jnp.float32)
+            )
+            plan.append(("int", len(i_cols), 1, jnp.int64))
+            i_cols.append(v)
+        elif r.op == "sum":
+            vals = r.values
+            valid = r.valid
+            if jnp.issubdtype(vals.dtype, jnp.integer) or vals.dtype == jnp.bool_:
+                nl = _limbs_for(vals.dtype)
+                v64 = vals.astype(jnp.int64)
+                if valid is not None:
+                    v64 = jnp.where(valid, v64, 0)
+                sign = jnp.where(v64 < 0, jnp.int64(-1), jnp.int64(1))
+                mag = jnp.abs(v64)
+                start = len(i_cols)
+                for l in range(nl):
+                    limb = ((mag >> (_LIMB_BITS * l)) & ((1 << _LIMB_BITS) - 1)) * sign
+                    i_cols.append(limb.astype(jnp.float32))
+                if nl > 1:
+                    carry_groups.append((start, nl))
+                plan.append(("limbs", start, nl, jnp.int64))
+            else:
+                v = vals.astype(jnp.float32)
+                if valid is not None:
+                    v = jnp.where(valid, v, jnp.float32(0))
+                plan.append(("float", len(f_cols), 1, jnp.float64))
+                f_cols.append(v)
+        elif r.op in ("min", "max"):
+            vals = r.values
+            valid = r.valid
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                v = vals.astype(jnp.float32)
+                sent = jnp.float32(jnp.inf if r.op == "min" else -jnp.inf)
+                if valid is not None:
+                    v = jnp.where(valid, v, sent)
+                if r.op == "min":
+                    plan.append(("min", len(mn_cols), 1, vals.dtype))
+                    mn_cols.append(v)
+                else:
+                    plan.append(("max", len(mx_cols), 1, vals.dtype))
+                    mx_cols.append(v)
+            elif _i32_ok(vals.dtype):
+                v = vals.astype(jnp.int32)
+                sent = _I32_MAX if r.op == "min" else _I32_MIN
+                if valid is not None:
+                    v = jnp.where(valid, v, sent)
+                if r.op == "min":
+                    plan.append(("imin", len(imn_cols), 1, vals.dtype))
+                    imn_cols.append(v)
+                else:
+                    plan.append(("imax", len(imx_cols), 1, vals.dtype))
+                    imx_cols.append(v)
+            else:
+                # int64 min/max: no native 64-bit lanes in the kernel and an
+                # f32 round-trip would corrupt values above 2^24 — use the
+                # exact XLA path for just this reduction.
+                plan.append(("xla", len(xla_reds), 1, vals.dtype))
+                xla_reds.append((ri, r))
+        else:
+            raise ValueError(f"unknown reduction {r.op}")
+
+    counts = (
+        len(f_cols), len(i_cols), len(mn_cols), len(mx_cols),
+        len(imn_cols), len(imx_cols),
+    )
+    af, ai, amn, amx, imn, imx = counts
+
+    def stack(cols, fill):
+        return jnp.stack([_prep_rows(c, n_pad, fill) for c in cols])
+
+    args = [seg2]
+    for cols, fill in (
+        (f_cols, 0.0), (i_cols, 0.0), (mn_cols, np.float32(np.inf)),
+        (mx_cols, np.float32(-np.inf)), (imn_cols, _I32_MAX), (imx_cols, _I32_MIN),
+    ):
+        if cols:
+            args.append(stack(cols, fill))
+
+    results: tuple = ()
+    if any(counts):
+        call = _make_kernel(
+            n_chunks, af, ai, amn, amx, imn, imx, g_pad, tuple(carry_groups), interpret
+        )
+        # Mosaic requires i32 grid indices; under the engine's global x64 mode
+        # the BlockSpec index maps trace to i64 and fail to legalize.  All
+        # kernel operands/outputs are f32/i32, so scoped-disabling x64 is sound.
+        with jax.enable_x64(False):
+            results = call(*args)
+        if not isinstance(results, (tuple, list)):
+            results = (results,)
+    it = iter(results)
+    of = next(it) if af else None
+    oi = next(it) if ai else None
+    omn = next(it) if amn else None
+    omx = next(it) if amx else None
+    oimn = next(it) if imn else None
+    oimx = next(it) if imx else None
+    xla_out = _xla_fallback(seg, [r for _, r in xla_reds], G) if xla_reds else []
+
+    out: list[jnp.ndarray] = []
+    for kind, idx, width, dtype in plan:
+        if kind == "float":
+            out.append(
+                of[idx, :G].astype(jnp.float64) + of[af + idx, :G].astype(jnp.float64)
+            )
+        elif kind == "int":
+            out.append(oi[idx, :G].astype(jnp.int64))
+        elif kind == "limbs":
+            total = jnp.zeros((G,), jnp.int64)
+            for l in range(width):
+                total = total + (
+                    oi[idx + l, :G].astype(jnp.int64) << (_LIMB_BITS * l)
+                )
+            out.append(total)
+        elif kind == "min":
+            out.append(omn[idx, :G].astype(dtype))
+        elif kind == "max":
+            out.append(omx[idx, :G].astype(dtype))
+        elif kind == "imin":
+            out.append(oimn[idx, :G].astype(dtype))
+        elif kind == "imax":
+            out.append(oimx[idx, :G].astype(dtype))
+        else:  # xla
+            out.append(xla_out[idx])
+    return out
+
+
+# --------------------------------------------------------------------------
+# XLA fallback (CPU tests / G beyond the one-hot ceiling)
+# --------------------------------------------------------------------------
+
+
+def _xla_fallback(seg, reds, G):
+    n = seg.shape[0]
+    num = G + 1  # overflow bucket for dead lanes
+    seg_c = jnp.minimum(seg.astype(jnp.int32), G)
+    out = []
+    for r in reds:
+        if r.op == "count":
+            v = (
+                r.valid.astype(jnp.int64)
+                if r.valid is not None
+                else jnp.ones((n,), jnp.int64)
+            )
+            out.append(jax.ops.segment_sum(v, seg_c, num_segments=num)[:G])
+        elif r.op == "sum":
+            vals = r.values
+            if jnp.issubdtype(vals.dtype, jnp.integer) or vals.dtype == jnp.bool_:
+                acc = vals.astype(jnp.int64)
+            else:
+                acc = vals.astype(jnp.float64)
+            if r.valid is not None:
+                acc = jnp.where(r.valid, acc, jnp.zeros_like(acc))
+            out.append(jax.ops.segment_sum(acc, seg_c, num_segments=num)[:G])
+        elif r.op == "min":
+            sel = r.values
+            if jnp.issubdtype(sel.dtype, jnp.floating):
+                sent = jnp.asarray(jnp.inf, sel.dtype)
+            else:
+                sent = jnp.iinfo(sel.dtype).max
+            if r.valid is not None:
+                sel = jnp.where(r.valid, sel, sent)
+            out.append(jax.ops.segment_min(sel, seg_c, num_segments=num)[:G])
+        elif r.op == "max":
+            sel = r.values
+            if jnp.issubdtype(sel.dtype, jnp.floating):
+                sent = jnp.asarray(-jnp.inf, sel.dtype)
+            else:
+                sent = jnp.iinfo(sel.dtype).min
+            if r.valid is not None:
+                sel = jnp.where(r.valid, sel, sent)
+            out.append(jax.ops.segment_max(sel, seg_c, num_segments=num)[:G])
+        else:
+            raise ValueError(r.op)
+    return out

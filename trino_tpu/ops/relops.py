@@ -188,11 +188,10 @@ def group_aggregate(
         out_keys.append((kdata, kvalid))
 
     # ---- aggregates -------------------------------------------------------
-    out_aggs: list[tuple[jnp.ndarray, Optional[jnp.ndarray]]] = []
-    for arg, spec in zip(agg_args, specs):
-        out_aggs.append(
-            _segment_agg(arg, spec, perm, seg, live_s, new_group, G, n)
-        )
+    out_aggs = _fused_aggs(agg_args, specs, perm, seg, live_s, G, n)
+    for i, (arg, spec) in enumerate(zip(agg_args, specs)):
+        if out_aggs[i] is None:  # DISTINCT: needs the sorted-adjacency trick
+            out_aggs[i] = _segment_agg(arg, spec, perm, seg, live_s, new_group, G, n)
 
     out_live = jnp.arange(G, dtype=jnp.int32) < jnp.minimum(n_groups, G)
     return out_keys, out_aggs, out_live, n_groups
@@ -244,10 +243,98 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live):
     for kv, codes in zip(key_vals, codes_per_key):
         out_keys.append((jnp.asarray(codes.astype(np.int32)), None))
 
-    out_aggs = []
-    for arg, spec in zip(agg_args, specs):
-        out_aggs.append(_segment_agg(arg, spec, None, seg, live, None, G, n))
+    out_aggs = _fused_aggs(agg_args, specs, None, seg, live, G, n)
     return out_keys, out_aggs, out_live, n_groups
+
+
+def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
+    """All non-DISTINCT aggregates of a GROUP BY in one fused segmented
+    reduction (ops/pallas/segreduce.py): on TPU a single Pallas pass over HBM
+    computes every SUM/COUNT/AVG on the MXU (exact int64 via limb
+    decomposition, Kahan-compensated doubles) and every MIN/MAX on the VPU;
+    on CPU the same call falls back to XLA segment ops.  This replaces the
+    reference's per-function Accumulator loop (operator/aggregation/, 224
+    files) with one bandwidth-bound kernel.
+
+    Returns a list aligned with specs; DISTINCT entries are None (the caller
+    computes those with the sorted-adjacency path).
+    """
+    from .pallas.segreduce import SegRed, fused_segment_reduce
+
+    reds: list = []
+    count_memo: dict = {}
+
+    def add(red) -> int:
+        reds.append(red)
+        return len(reds) - 1
+
+    def add_count(valid) -> int:
+        key = id(valid)
+        if key not in count_memo:
+            count_memo[key] = add(SegRed("count", None, valid))
+        return count_memo[key]
+
+    recipe: list = []
+    for arg, spec in zip(agg_args, specs):
+        if spec.distinct:
+            recipe.append(None)
+            continue
+        if spec.fn == "count_star":
+            recipe.append(("count", add_count(live_s)))
+            continue
+        data = arg.data if perm is None else jnp.take(arg.data, perm)
+        valid = _valid_of(arg, n)
+        if perm is not None:
+            valid = jnp.take(valid, perm)
+        valid = valid & live_s
+        if spec.fn == "count":
+            recipe.append(("count", add_count(valid)))
+        elif spec.fn in ("sum", "avg"):
+            as_int = spec.fn == "sum" and jnp.issubdtype(data.dtype, jnp.integer)
+            vals = data if as_int else data.astype(jnp.float64)
+            recipe.append((spec.fn, add(SegRed("sum", vals, valid)), add_count(valid)))
+        elif spec.fn in ("min", "max"):
+            if arg.dict is not None:
+                rank = jnp.take(jnp.asarray(arg.dict.sorted_rank()), arg.data)
+                rdata = rank if perm is None else jnp.take(rank, perm)
+                recipe.append(
+                    ("dictmm", spec.fn, arg, add(SegRed(spec.fn, rdata, valid)), add_count(valid))
+                )
+            else:
+                recipe.append(("minmax", add(SegRed(spec.fn, data, valid)), add_count(valid)))
+        else:
+            raise NotImplementedError(f"aggregate {spec.fn}")
+
+    results = fused_segment_reduce(seg, reds, G) if reds else []
+
+    out: list = []
+    for r in recipe:
+        if r is None:
+            out.append(None)
+            continue
+        kind = r[0]
+        if kind == "count":
+            out.append((results[r[1]], None))
+        elif kind in ("sum", "avg"):
+            s, cnt = results[r[1]], results[r[2]]
+            nonempty = cnt > 0
+            if kind == "sum":
+                out.append((s, nonempty))
+            else:
+                out.append((s / jnp.where(nonempty, cnt, 1).astype(jnp.float64), nonempty))
+        elif kind == "minmax":
+            s, cnt = results[r[1]], results[r[2]]
+            out.append((s, cnt > 0))
+        else:  # dictmm: map best rank back to a dictionary code
+            _, fn, arg, si, ci = r
+            best_rank, cnt = results[si], results[ci]
+            inv = np.argsort(arg.dict.sorted_rank()).astype(np.int32)
+            code = jnp.take(
+                jnp.asarray(inv),
+                jnp.clip(best_rank.astype(jnp.int32), 0, len(inv) - 1),
+            )
+            out.append((code, cnt > 0))
+    return out
 
 
 def _scatter_first(values: jnp.ndarray, seg: jnp.ndarray, new_group: jnp.ndarray, G: int):
@@ -267,86 +354,40 @@ def _segment_agg(
     G: int,
     n: int,
 ):
+    """DISTINCT aggregates only — everything else is fused (_fused_aggs).
+
+    Requires the sort-based grouping: rows arrive ordered by (group keys,
+    distinct argument), so the first occurrence of each value within its
+    group is an adjacency test.
+    """
     num = G + 1  # +1 overflow bucket for dead lanes
-    if spec.fn == "count_star":
-        ones = live_s.astype(jnp.int64)
-        out = _segment_sum(ones, seg, num)[:G]
-        return out, None
-
-    if perm is None:  # fast path: rows unsorted, identity permutation
-        data_s = arg.data
-        valid_s = _valid_of(arg, n) & live_s
-    else:
-        data_s = jnp.take(arg.data, perm)
-        valid_s = jnp.take(_valid_of(arg, n), perm) & live_s
-
-    if spec.distinct:
-        # rows sorted by (keys, value): count first occurrence of each value
-        prev = jnp.concatenate([data_s[:1], data_s[:-1]])
-        first_in_group = new_group
-        new_val = first_in_group | (data_s != prev)
-        contrib = (new_val & valid_s).astype(jnp.int64)
-        if spec.fn != "count":
-            raise NotImplementedError(f"DISTINCT {spec.fn}")
-        out = _segment_sum(contrib, seg, num)[:G]
-        return out, None
-
-    if spec.fn == "count":
-        out = _segment_sum(valid_s.astype(jnp.int64), seg, num)[:G]
-        return out, None
-
-    cnt = _segment_sum(valid_s.astype(jnp.int64), seg, num)[:G]
-    nonempty = cnt > 0
-    if spec.fn in ("sum", "avg"):
-        if spec.fn == "avg" or jnp.issubdtype(data_s.dtype, jnp.floating):
-            acc = data_s.astype(jnp.float64)
-        else:
-            acc = data_s.astype(jnp.int64)
-        acc = jnp.where(valid_s, acc, jnp.zeros_like(acc))
-        s = _segment_sum(acc, seg, num)[:G]
-        if spec.fn == "sum":
-            return s, nonempty
-        avg = s / jnp.where(nonempty, cnt, 1).astype(jnp.float64)
-        return avg, nonempty
-    if spec.fn in ("min", "max"):
-        if arg.dict is not None:
-            rank = jnp.take(jnp.asarray(arg.dict.sorted_rank()), arg.data)
-            rank_s = rank if perm is None else jnp.take(rank, perm)
-            sel = rank_s if spec.fn == "min" else -rank_s
-            sentinel = jnp.iinfo(sel.dtype).max
-            sel = jnp.where(valid_s, sel, sentinel)
-            best = jax.ops.segment_min(sel, seg, num_segments=num)[:G]
-            best_rank = best if spec.fn == "min" else -best
-            inv = np.argsort(arg.dict.sorted_rank()).astype(np.int32)
-            code = jnp.take(jnp.asarray(inv), jnp.clip(best_rank, 0, len(inv) - 1))
-            return code, nonempty
-        sel = data_s
-        if spec.fn == "min":
-            if jnp.issubdtype(sel.dtype, jnp.floating):
-                sentinel = jnp.asarray(jnp.inf, sel.dtype)
-            else:
-                sentinel = jnp.iinfo(sel.dtype).max
-            sel = jnp.where(valid_s, sel, sentinel)
-            out = jax.ops.segment_min(sel, seg, num_segments=num)[:G]
-        else:
-            if jnp.issubdtype(sel.dtype, jnp.floating):
-                sentinel = jnp.asarray(-jnp.inf, sel.dtype)
-            else:
-                sentinel = jnp.iinfo(sel.dtype).min
-            sel = jnp.where(valid_s, sel, sentinel)
-            out = jax.ops.segment_max(sel, seg, num_segments=num)[:G]
-        return out, nonempty
-    raise NotImplementedError(f"aggregate {spec.fn}")
+    assert spec.distinct, "non-DISTINCT aggregates run through _fused_aggs"
+    data_s = jnp.take(arg.data, perm)
+    valid_s = jnp.take(_valid_of(arg, n), perm) & live_s
+    prev = jnp.concatenate([data_s[:1], data_s[:-1]])
+    new_val = new_group | (data_s != prev)
+    contrib = (new_val & valid_s).astype(jnp.int64)
+    if spec.fn != "count":
+        raise NotImplementedError(f"DISTINCT {spec.fn}")
+    out = _segment_sum(contrib, seg, num)[:G]
+    return out, None
 
 
 def _global_aggregate(agg_args, specs, live):
-    """No GROUP BY: one output row even over empty input (SQL semantics)."""
+    """No GROUP BY: one output row even over empty input (SQL semantics).
+
+    Non-DISTINCT aggregates run through the fused segmented reduction with a
+    single segment — on TPU that means the Pallas kernel's exact-int64 and
+    Kahan-compensated float paths serve global sums too (a plain jnp.sum of
+    "float64" on TPU silently accumulates in f32)."""
+    n = live.shape[0]
+    seg = jnp.zeros((n,), jnp.int32)
+    fused = _fused_aggs(agg_args, specs, None, seg, live, 1, n)
     out_aggs = []
-    for arg, spec in zip(agg_args, specs):
-        if spec.fn == "count_star":
-            out_aggs.append((jnp.sum(live.astype(jnp.int64)).reshape(1), None))
+    for (arg, spec), pre in zip(zip(agg_args, specs), fused):
+        if pre is not None:
+            out_aggs.append(pre)
             continue
-        n = live.shape[0]
         valid = _valid_of(arg, n) & live
         if spec.distinct:
             k = _sortable_key(arg)
@@ -357,31 +398,7 @@ def _global_aggregate(agg_args, specs, live):
             cnt = jnp.sum(((first | (k_s != prev)) & vs).astype(jnp.int64))
             out_aggs.append((cnt.reshape(1), None))
             continue
-        if spec.fn == "count":
-            out_aggs.append((jnp.sum(valid.astype(jnp.int64)).reshape(1), None))
-            continue
-        cnt = jnp.sum(valid.astype(jnp.int64))
-        nonempty = (cnt > 0).reshape(1)
-        data = arg.data
-        if spec.fn in ("sum", "avg"):
-            acc = data.astype(jnp.float64 if (spec.fn == "avg" or jnp.issubdtype(data.dtype, jnp.floating)) else jnp.int64)
-            acc = jnp.where(valid, acc, jnp.zeros_like(acc))
-            s = jnp.sum(acc)
-            if spec.fn == "sum":
-                out_aggs.append((s.reshape(1), nonempty))
-            else:
-                out_aggs.append(((s / jnp.maximum(cnt, 1).astype(jnp.float64)).reshape(1), nonempty))
-        elif spec.fn in ("min", "max"):
-            if jnp.issubdtype(data.dtype, jnp.floating):
-                sent = jnp.asarray(jnp.inf if spec.fn == "min" else -jnp.inf, data.dtype)
-            else:
-                info = jnp.iinfo(data.dtype)
-                sent = jnp.asarray(info.max if spec.fn == "min" else info.min, data.dtype)
-            sel = jnp.where(valid, data, sent)
-            r = jnp.min(sel) if spec.fn == "min" else jnp.max(sel)
-            out_aggs.append((r.reshape(1), nonempty))
-        else:
-            raise NotImplementedError(spec.fn)
+        raise NotImplementedError(spec.fn)  # non-distinct is fully fused above
     out_live = jnp.ones((1,), jnp.bool_)
     return [], out_aggs, out_live, jnp.int32(1)
 
@@ -405,15 +422,27 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
 def _combined_hash(keys: Sequence[ColumnVal], live: jnp.ndarray, n: int, sentinel: int):
     """Hash-combine key columns to int63; rows that are dead or have a null
     key get `sentinel` (never matches).  Exact key equality is re-verified
-    after candidate expansion, so collisions only cost, never corrupt."""
+    after candidate expansion, so collisions only cost, never corrupt.
+
+    VARCHAR columns hash by dictionary VALUE via Dictionary.hash64() (the
+    one value-hash table, shared with runtime/wire.py partition_page) — so
+    hash-partitioning two different varchar columns routes equal strings to
+    the same shard even though their code spaces differ.  This is what lets
+    string-keyed joins run PARTITIONED instead of forcing broadcast."""
     h = jnp.zeros((n,), dtype=jnp.uint64)
     ok = live
     for kv in keys:
-        bits = kv.data
-        if jnp.issubdtype(bits.dtype, jnp.floating):
-            bits = jax.lax.bitcast_convert_type(bits.astype(jnp.float64), jnp.uint64)
+        if kv.dict is not None:
+            table = kv.dict.hash64()
+            bits = jnp.take(
+                jnp.asarray(table), jnp.clip(kv.data, 0, len(table) - 1)
+            )
         else:
-            bits = bits.astype(jnp.int64).astype(jnp.uint64)
+            bits = kv.data
+            if jnp.issubdtype(bits.dtype, jnp.floating):
+                bits = jax.lax.bitcast_convert_type(bits.astype(jnp.float64), jnp.uint64)
+            else:
+                bits = bits.astype(jnp.int64).astype(jnp.uint64)
         h = _mix64(h ^ _mix64(bits))
         ok = ok & _valid_of(kv, n)
     h = (h & jnp.uint64(0x3FFF_FFFF_FFFF_FFFF)).astype(jnp.int64)
@@ -617,9 +646,68 @@ def sort_rows(
     return out, jnp.take(live, perm)
 
 
-def top_n(cols, live, keys, specs, count: int):
+def top_n(cols, live, keys, specs, count: int, cap: Optional[int] = None):
+    """TopN.  Returns (cols, live, required).
+
+    Radix-select path (TPU, large inputs): find the exact K-th threshold of
+    the leading key in four histogram passes (ops/pallas/topk.py), compact
+    the <= `cap` candidate rows, and sort only those — no O(n log n) sort,
+    no full-width permutation of the relation (the reference's bounded-heap
+    TopNOperator.java:32 economy, achieved with branch-free vector passes).
+    `required` is the candidate count for the executor's capacity retry;
+    the sort fallback reports 0 (never retries).
+    """
+    n = live.shape[0]
+    from .pallas.topk import radix_topk_supported, radix_topk_threshold, sortable_u32
+
+    if cap is not None and cap >= count and keys and radix_topk_supported(n, count):
+        kv, spec = keys[0], specs[0]
+        valid = _valid_of(kv, n)
+        u = sortable_u32(_sortable_key(kv), descending=False)
+        if spec.ascending:  # first rows of the order == smallest keys
+            u = ~u
+        null_u = jnp.uint32(0xFFFFFFFF) if spec.nulls_first else jnp.uint32(0)
+        u = jnp.where(valid, u, null_u)
+        thresh = radix_topk_threshold(u, live, count)
+        cand = live & (u >= thresh)
+        required = jnp.sum(cand.astype(jnp.int64))
+        # compact candidate row ids into the static buffer
+        pos = jnp.cumsum(cand.astype(jnp.int32)) - 1
+        scatter_to = jnp.where(cand, pos, cap)
+        idx_buf = (
+            jnp.zeros((cap,), jnp.int32)
+            .at[scatter_to]
+            .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+        )
+        lane_live = jnp.arange(cap, dtype=jnp.int64) < jnp.minimum(
+            required, cap
+        )
+
+        def gather(cv: ColumnVal) -> ColumnVal:
+            return ColumnVal(
+                jnp.take(cv.data, idx_buf),
+                None if cv.valid is None else jnp.take(cv.valid, idx_buf),
+                cv.dict,
+                cv.type,
+            )
+
+        sub_cols = [gather(cv) for cv in cols]
+        sub_keys = [gather(kv_) for kv_ in keys]
+        sorted_cols, sorted_live = sort_rows(sub_cols, lane_live, sub_keys, specs)
+        k = min(count, n)
+        out = [
+            ColumnVal(
+                cv.data[:k],
+                None if cv.valid is None else cv.valid[:k],
+                cv.dict,
+                cv.type,
+            )
+            for cv in sorted_cols
+        ]
+        return out, sorted_live[:k], required
+
     sorted_cols, sorted_live = sort_rows(cols, live, keys, specs)
-    k = min(count, live.shape[0])
+    k = min(count, n)
     out = [
         ColumnVal(
             cv.data[:k],
@@ -629,7 +717,7 @@ def top_n(cols, live, keys, specs, count: int):
         )
         for cv in sorted_cols
     ]
-    return out, sorted_live[:k]
+    return out, sorted_live[:k], jnp.int64(0)
 
 
 def limit_mask(live: jnp.ndarray, count: int) -> jnp.ndarray:

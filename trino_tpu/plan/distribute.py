@@ -92,31 +92,12 @@ class _Distributor:
 
     # ------------------------------------------------------------ size model
     def est_rows(self, node: PlanNode) -> float:
-        if isinstance(node, TableScan):
-            conn = self.catalogs.get(node.catalog)
-            n = conn.estimated_row_count(node.table)
-            return float(n if n is not None else 1_000_000)
-        if isinstance(node, Filter):
-            return 0.3 * self.est_rows(node.child)
-        if isinstance(node, (Project, Exchange, Sort, Window)):
-            return self.est_rows(node.child)
-        if isinstance(node, Aggregate):
-            return max(1.0, 0.1 * self.est_rows(node.child))
-        if isinstance(node, Distinct):
-            return max(1.0, 0.5 * self.est_rows(node.child))
-        if isinstance(node, Join):
-            if node.kind in ("semi", "anti", "null_anti"):
-                return self.est_rows(node.left)
-            if node.kind == "cross":
-                return self.est_rows(node.left)
-            return max(self.est_rows(node.left), self.est_rows(node.right))
-        if isinstance(node, (TopN, Limit)):
-            return float(min(node.count, int(self.est_rows(node.child))))
-        if isinstance(node, Values):
-            return float(len(node.rows))
-        if isinstance(node, Concat):
-            return sum(self.est_rows(c) for c in node.inputs)
-        return 1_000_000.0
+        """Cardinality from the stats calculator (plan/stats.py): connector
+        NDV/min-max stats drive filter selectivity, join fan-out and group
+        counts (reference: cost/ — FilterStatsCalculator, JoinStatsRule)."""
+        from .stats import estimate
+
+        return estimate(node, self.catalogs).rows
 
     # --------------------------------------------------------------- visitor
     def visit(self, node: PlanNode) -> tuple[PlanNode, _Part]:
@@ -336,12 +317,10 @@ class _Distributor:
             )
 
         est_right = self.est_rows(node.right)
-        varchar_keys = any(k.type.is_string for k in node.left_keys)
         mode = self._join_mode()
         broadcast = (
             (mode == "BROADCAST")
             or (mode == "AUTOMATIC" and est_right <= self._broadcast_limit())
-            or varchar_keys
             or not node.left_keys
             or rpart.kind == "replicated"
             # null_anti needs a global view of the build side: a NULL build

@@ -381,8 +381,19 @@ def walk(node: PlanNode):
         yield from walk(c)
 
 
-def format_plan(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style plan rendering."""
+def format_plan(
+    node: PlanNode,
+    indent: int = 0,
+    annotations: "Optional[dict[int, str]]" = None,
+    _counter: "Optional[list[int]]" = None,
+) -> str:
+    """EXPLAIN-style plan rendering.  `annotations` maps preorder node ids
+    (the executor's numbering, exec/compiler.py _node_ids) to suffix strings
+    — EXPLAIN ANALYZE appends per-operator stats this way."""
+    if _counter is None:
+        _counter = [0]
+    nid = _counter[0]
+    _counter[0] += 1
     pad = "  " * indent
     label = type(node).__name__
     detail = ""
@@ -410,7 +421,8 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
         detail = f" {node.kind}" + (
             f" keys={[str(k) for k in node.keys]}" if node.keys else ""
         )
-    lines = [f"{pad}{label}{detail}"]
+    suffix = annotations.get(nid, "") if annotations else ""
+    lines = [f"{pad}{label}{detail}{suffix}"]
     for c in node.children:
-        lines.append(format_plan(c, indent + 1))
+        lines.append(format_plan(c, indent + 1, annotations, _counter))
     return "\n".join(lines)

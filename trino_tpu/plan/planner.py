@@ -33,7 +33,7 @@ from typing import Callable, Optional, Sequence
 
 from ..connectors.spi import CatalogManager
 from ..data.types import (
-    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, Type, UNKNOWN, VARCHAR,
+    BIGINT, BOOLEAN, DATE, DOUBLE, DecimalType, INTEGER, Type, UNKNOWN, VARCHAR,
     common_super_type, date_to_days,
 )
 from ..sql import ast as A
@@ -365,21 +365,45 @@ class Planner:
             else:
                 remaining.append(c)
 
-        # greedy left-deep join tree over equality edges (EliminateCrossJoins)
-        joined = plans[0]
-        pending = list(range(1, len(plans)))
+        # cost-based left-deep join tree over equality edges (reference:
+        # iterative/rule/ReorderJoins + EliminateCrossJoins): the LARGEST
+        # relation (post-pushdown stats) anchors the probe spine and the
+        # remaining relations join smallest-first as RIGHT (build) sides —
+        # small builds broadcast cheaply and keep expansion frames tight
+        def _size(p: RelationPlan) -> float:
+            from .stats import estimate as _est
+
+            try:
+                return _est(p.node, self.catalogs).rows
+            except Exception:
+                return 1e6
+
+        sizes = [_size(p) for p in plans]
+        start = max(range(len(plans)), key=lambda i: sizes[i])
+        joined = plans[start]
+        pending = [i for i in range(len(plans)) if i != start]
         while pending:
-            picked = None
-            for j in pending:
-                keys = _equi_keys(remaining, joined.scope, plans[j].scope)
-                if keys:
-                    picked = j
-                    break
-            if picked is None:
-                picked = pending[0]
+            connected = [
+                j for j in pending
+                if _equi_keys(remaining, joined.scope, plans[j].scope)
+            ]
+            pool = connected or pending
+            picked = min(pool, key=lambda j: sizes[j])
             right = plans[picked]
             pending.remove(picked)
             joined = self._make_join("inner", joined, right, remaining, outer)
+
+        # restore FROM-order field layout: the physical join order is a cost
+        # decision and must not leak into name resolution or SELECT * order
+        # (fields are shared objects, so identity maps join-order -> FROM-order)
+        want = [f for p in plans for f in p.fields]
+        if [id(f) for f in joined.fields] != [id(f) for f in want]:
+            pos = {id(f): i for i, f in enumerate(joined.fields)}
+            exprs = tuple(FieldRef(pos[id(f)], f.type) for f in want)
+            names = tuple(
+                f.name if f.name is not None else f"_h{i}" for i, f in enumerate(want)
+            )
+            joined = RelationPlan(Project(joined.node, exprs, names), want)
 
         # residual multi-relation predicates
         node = joined.node
@@ -449,11 +473,20 @@ class Planner:
                 return RelationPlan(
                     sub.node, [Field(alias, f.name, f.type) for f in sub.fields]
                 )
-            connector = self.catalogs.get(self.default_catalog)
+            catalog = r.catalog or self.default_catalog
+            try:
+                connector = self.catalogs.get(catalog)
+            except KeyError:
+                if r.catalog is None:
+                    raise
+                # schema.table (Trino 2-part semantics): the first part is a
+                # schema inside the default catalog, not a catalog name
+                catalog = self.default_catalog
+                connector = self.catalogs.get(catalog)
             schema = connector.table_schema(r.name)
             names = tuple(schema.column_names())
             types = tuple(c.type for c in schema.columns)
-            node = TableScan(self.default_catalog, r.name, names, types)
+            node = TableScan(catalog, r.name, names, types)
             alias = r.alias or r.name
             return RelationPlan(node, [Field(alias, n, t) for n, t in zip(names, types)])
         if isinstance(r, A.SubqueryRelation):
@@ -534,6 +567,10 @@ class Planner:
                 aggs.append(AggCall("count_star", None, BIGINT))
                 continue
             arg = t.translate(fc.args[0])
+            if fc.name == "avg" and arg.type.is_decimal:
+                # avg over decimals divides at the end in f64; feeding the
+                # accumulator doubles keeps relops scale-agnostic
+                arg = _cast_ir(arg, DOUBLE)
             out_t = _agg_type(fc.name, arg.type)
             aggs.append(AggCall(fc.name, arg, out_t, fc.distinct))
         names = tuple(f"_g{i}" for i in range(len(group_irs))) + tuple(
@@ -617,6 +654,10 @@ class Planner:
                     frame = "range" if w_order_by else "whole"
                 fn = wf.name
                 args = tuple(t.translate(a) for a in wf.args)
+                if fn in ("sum", "avg") and args and args[0].type.is_decimal:
+                    # window accumulators run in f64 lanes; decimals enter as
+                    # doubles (exact to 2^53 on the CPU; see ops/window.py)
+                    args = (_cast_ir(args[0], DOUBLE),) + args[1:]
                 if fn in ("row_number", "rank", "dense_rank"):
                     out_t = BIGINT
                 elif fn == "count":
@@ -850,6 +891,8 @@ class Planner:
                 aggs.append(AggCall("count_star", None, BIGINT))
             else:
                 arg = inner_t.translate(fc.args[0])
+                if fc.name == "avg" and arg.type.is_decimal:
+                    arg = _cast_ir(arg, DOUBLE)
                 aggs.append(AggCall(fc.name, arg, _agg_type(fc.name, arg.type), fc.distinct))
         nk = len(inner_keys)
         agg_names = tuple(f"_g{i}" for i in range(nk)) + tuple(
@@ -891,8 +934,7 @@ class Planner:
         op_t = _Translator(joined.scope, outer, agg_map=translator.agg_map)
         lhs = op_t.translate(operand_ast)
         rhs = FieldRef(len(new_fields) - 1, value_ir.type)
-        tt = common_super_type(lhs.type, rhs.type)
-        pred = Call(cmp_op, (_cast_ir(lhs, tt), _cast_ir(rhs, tt)), BOOLEAN)
+        pred = _cmp(cmp_op, lhs, rhs)  # decimal-overflow-aware comparison
         filtered = Filter(joined.node, pred)
         # project away the scratch columns
         keep = list(range(len(rel.fields)))
@@ -942,6 +984,9 @@ class _Translator:
             return Const(e.value, BIGINT)
         if isinstance(e, A.FloatLit):
             return Const(e.value, DOUBLE)
+        if isinstance(e, A.DecimalLit):
+            p = max(len(str(abs(e.unscaled))), e.scale)
+            return Const(e.unscaled, DecimalType(p, e.scale))
         if isinstance(e, A.StrLit):
             return Const(e.value, VARCHAR)
         if isinstance(e, A.BoolLit):
@@ -1036,9 +1081,30 @@ class _Translator:
             return _cmp(_CMP_OPS[e.op], a, b)
         # arithmetic
         op = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod"}[e.op]
+        a = _tighten_int_const(a, b.type)
+        b = _tighten_int_const(b, a.type)
+        dec_mix = (a.type.is_decimal or b.type.is_decimal) and not (
+            a.type.is_floating or b.type.is_floating
+        )
+        if dec_mix and op == "mul":
+            # decimal multiply: scales add on the raw int64 lanes — no
+            # operand rescaling (reference: decimal operator typing)
+            ta = a.type if a.type.is_decimal else DecimalType(18, 0)
+            tb = b.type if b.type.is_decimal else DecimalType(18, 0)
+            out_t = DecimalType(min(18, ta.precision + tb.precision), ta.scale + tb.scale)
+            if isinstance(a, Const) and isinstance(b, Const) and a.value is not None and b.value is not None:
+                return Const(a.value * b.value, out_t)
+            return Call("mul", (a, b), out_t)
+        if dec_mix and op == "div":
+            # decimal division degrades to DOUBLE (Int128 rescale division is
+            # future work; TPC-H divisions all feed double expressions)
+            a = _cast_ir(a, DOUBLE)
+            b = _cast_ir(b, DOUBLE)
+            out_t = DOUBLE
+            if isinstance(a, Const) and isinstance(b, Const) and a.value is not None and b.value is not None:
+                return Const(_fold_arith(op, a.value, b.value), out_t)
+            return Call(op, (a, b), out_t)
         out_t = common_super_type(a.type, b.type)
-        if op == "div" and not out_t.is_floating and not out_t.name.startswith("decimal"):
-            out_t = out_t  # SQL integer division truncates
         # constant folding keeps literals out of kernels where possible
         a = _cast_ir(a, out_t)
         b = _cast_ir(b, out_t)
@@ -1070,11 +1136,20 @@ class _Translator:
             return Call("coalesce", tuple(_cast_ir(a, out_t) for a in args), out_t)
         if name in ("abs", "round", "floor", "ceil", "ceiling", "sqrt"):
             op = "ceil" if name == "ceiling" else name
-            t = args[0].type if name in ("abs",) else DOUBLE
+            if name == "abs":
+                return Call("abs", args, args[0].type)
+            # float functions: decimals go in as doubles (the runtime kernels
+            # are f64 lanes; Trino's decimal round/floor is future work)
+            args = tuple(
+                _cast_ir(a, DOUBLE) if a.type.is_decimal else a for a in args
+            )
             if name == "round" and len(args) == 2:
                 return Call("round", args, args[0].type)
-            return Call(op, args, t)
+            return Call(op, args, DOUBLE)
         if name == "power" or name == "pow":
+            args = tuple(
+                _cast_ir(a, DOUBLE) if a.type.is_decimal else a for a in args
+            )
             return Call("power", args, DOUBLE)
         if name == "year":
             return Call("extract_year", args, BIGINT)
@@ -1088,8 +1163,33 @@ class _Translator:
 # ------------------------------------------------------------------ helpers
 
 
+def _tighten_int_const(e: IrExpr, other: Type) -> IrExpr:
+    """An integer literal next to a decimal gets its actual digit count as
+    precision (1 -> decimal(1,0)), not the worst-case decimal(18,0) — the
+    reference's analyzer does the same so small literals don't force
+    everything to DOUBLE."""
+    if (
+        other.is_decimal
+        and isinstance(e, Const)
+        and e.type.is_integer
+        and e.value is not None
+    ):
+        return Const(e.value, DecimalType(max(1, len(str(abs(e.value)))), 0))
+    return e
+
+
 def _cmp(op: str, a: IrExpr, b: IrExpr) -> IrExpr:
+    a = _tighten_int_const(a, b.type)
+    b = _tighten_int_const(b, a.type)
     tt = common_super_type(a.type, b.type)
+    if tt.is_decimal:
+        # rescaling either side to the common scale must stay inside int64:
+        # whole digits + common scale <= 18, else compare as doubles
+        for t in (a.type, b.type):
+            whole = (t.precision - t.scale) if t.is_decimal else 18
+            if whole + tt.scale > 18:
+                tt = DOUBLE
+                break
     return Call(op, (_cast_ir(a, tt), _cast_ir(b, tt)), BOOLEAN)
 
 
@@ -1097,13 +1197,31 @@ def _cast_ir(e: IrExpr, target: Type) -> IrExpr:
     if e.type == target:
         return e
     if isinstance(e, Const):
-        return Const(_cast_const(e.value, target), target)
+        return Const(_cast_const(e.value, target, e.type), target)
     return Call("cast", (e,), target)
 
 
-def _cast_const(v, target: Type):
+def _round_half(v: int, div: int) -> int:
+    """Round-half-away-from-zero integer division (Trino decimal rescale)."""
+    sign = -1 if v < 0 else 1
+    return sign * ((abs(v) + div // 2) // div)
+
+
+def _cast_const(v, target: Type, source: Type = UNKNOWN):
     if v is None:
         return None
+    if target.is_decimal:
+        src_scale = source.scale if source.is_decimal else 0
+        if source.is_floating or isinstance(v, float):
+            return round(float(v) * 10**target.scale)
+        if target.scale >= src_scale:
+            return int(v) * 10 ** (target.scale - src_scale)
+        return _round_half(int(v), 10 ** (src_scale - target.scale))
+    if source.is_decimal:
+        if target.is_floating:
+            return int(v) / 10**source.scale
+        if target.is_integer:
+            return _round_half(int(v), 10**source.scale)
     if target.is_floating:
         return float(v)
     if target.is_integer:
@@ -1315,6 +1433,10 @@ def _agg_type(fn: str, arg_t: Type) -> Type:
     if fn == "sum":
         if arg_t.is_integer:
             return BIGINT
+        if arg_t.is_decimal:
+            # widen to the max short-decimal precision (reference widens to
+            # decimal(38,s); int64 lanes cap at 18)
+            return DecimalType(18, arg_t.scale)
         return DOUBLE if arg_t.is_floating else arg_t
     return arg_t  # min / max
 

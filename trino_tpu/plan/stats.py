@@ -1,0 +1,242 @@
+"""Plan statistics: cardinality + column-stats propagation for costing.
+
+A compact analogue of the reference's stats calculator stack
+(cost/StatsCalculator, FilterStatsCalculator, JoinStatsRule,
+AggregationStatsRule): connector-supplied base stats (NDV, min/max, null
+fraction — spi/statistics) propagate bottom-up through Filter/Project, and
+the estimators that matter for physical decisions use them:
+
+- filter selectivity: equality -> 1/NDV, range -> fraction of [min,max],
+  IN -> k/NDV, conjunction multiplies (independence assumption)
+- join output: |L|*|R| / max(NDV(lk), NDV(rk))  (the classic Selinger form;
+  FK->PK joins collapse to |L|)
+- aggregate output: min(child rows, product of group-key NDVs)
+
+Used by plan/distribute.py to choose join distribution (broadcast vs
+partitioned) and by the executor's capacity planning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..connectors.spi import CatalogManager, ColumnStats
+from .ir import Call, Const, FieldRef, InListIr, IrExpr, LikeIr
+from .nodes import (
+    Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit, PlanNode,
+    Project, RemoteSource, Sort, TableScan, TopN, Values, Window,
+)
+
+__all__ = ["PlanStats", "estimate"]
+
+_DEFAULT_FILTER_SEL = 0.3
+_DEFAULT_ROWS = 1_000_000.0
+
+
+@dataclass(frozen=True)
+class PlanStats:
+    rows: float
+    # output column index -> ColumnStats (only where derivable)
+    columns: dict
+
+
+def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:
+    """Bottom-up stats for a plan node (memoization is the caller's concern;
+    plans are small)."""
+    if isinstance(node, TableScan):
+        conn = catalogs.get(node.catalog)
+        ts = None
+        try:
+            ts = conn.table_stats(node.table)
+        except Exception:
+            ts = None
+        if ts is not None:
+            cols = {
+                i: ts.columns[name]
+                for i, name in enumerate(node.column_names)
+                if name in ts.columns
+            }
+            return PlanStats(ts.row_count, cols)
+        n = conn.estimated_row_count(node.table)
+        return PlanStats(float(n) if n is not None else _DEFAULT_ROWS, {})
+
+    if isinstance(node, Filter):
+        child = estimate(node.child, catalogs)
+        sel = _selectivity(node.predicate, child)
+        cols = {
+            i: ColumnStats(
+                None if c.ndv is None else max(1.0, c.ndv * sel),
+                c.min, c.max, c.null_fraction,
+            )
+            for i, c in child.columns.items()
+        }
+        return PlanStats(max(1.0, child.rows * sel), cols)
+
+    if isinstance(node, Project):
+        child = estimate(node.child, catalogs)
+        cols = {}
+        for i, e in enumerate(node.expressions):
+            if isinstance(e, FieldRef) and e.index in child.columns:
+                cols[i] = child.columns[e.index]
+        return PlanStats(child.rows, cols)
+
+    if isinstance(node, (Exchange, Sort, Window)):
+        child = estimate(node.child, catalogs)
+        return PlanStats(child.rows, child.columns)
+
+    if isinstance(node, Aggregate):
+        child = estimate(node.child, catalogs)
+        if not node.group_keys:
+            return PlanStats(1.0, {})
+        groups = 1.0
+        known = True
+        for k in node.group_keys:
+            nd = _expr_ndv(k, child)
+            if nd is None:
+                known = False
+                break
+            groups *= nd
+        if not known:
+            groups = max(1.0, 0.1 * child.rows)
+        rows = max(1.0, min(child.rows, groups))
+        cols = {}
+        for i, k in enumerate(node.group_keys):
+            if isinstance(k, FieldRef) and k.index in child.columns:
+                cols[i] = child.columns[k.index]
+        return PlanStats(rows, cols)
+
+    if isinstance(node, Distinct):
+        child = estimate(node.child, catalogs)
+        return PlanStats(max(1.0, 0.5 * child.rows), child.columns)
+
+    if isinstance(node, Join):
+        left = estimate(node.left, catalogs)
+        right = estimate(node.right, catalogs)
+        if node.kind in ("semi", "anti", "null_anti"):
+            return PlanStats(max(1.0, 0.5 * left.rows), left.columns)
+        if node.kind == "cross":
+            return PlanStats(left.rows, left.columns)
+        ndv = None
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            ln = _expr_ndv(lk, left)
+            rn = _expr_ndv(rk, right)
+            for v in (ln, rn):
+                if v is not None:
+                    ndv = v if ndv is None else max(ndv, v)
+        if ndv:
+            rows = max(1.0, left.rows * right.rows / ndv)
+        else:
+            rows = max(left.rows, right.rows)
+        if node.kind == "left":
+            rows = max(rows, left.rows)
+        cols = dict(left.columns)
+        off = len(node.left.output_types)
+        for i, c in right.columns.items():
+            cols[off + i] = c
+        return PlanStats(rows, cols)
+
+    if isinstance(node, (TopN, Limit)):
+        child = estimate(node.child, catalogs)
+        return PlanStats(float(min(node.count, child.rows)), child.columns)
+
+    if isinstance(node, Values):
+        return PlanStats(float(len(node.rows)), {})
+
+    if isinstance(node, Concat):
+        rows = sum(estimate(c, catalogs).rows for c in node.inputs)
+        return PlanStats(rows, {})
+
+    if isinstance(node, RemoteSource):
+        return PlanStats(_DEFAULT_ROWS, {})
+
+    return PlanStats(_DEFAULT_ROWS, {})
+
+
+def _expr_ndv(e: IrExpr, stats: PlanStats) -> Optional[float]:
+    if isinstance(e, FieldRef) and e.index in stats.columns:
+        return stats.columns[e.index].ndv
+    if isinstance(e, Const):
+        return 1.0
+    return None
+
+
+def _selectivity(pred: IrExpr, stats: PlanStats) -> float:
+    """FilterStatsCalculator in miniature: conjuncts multiply."""
+    if isinstance(pred, Call):
+        op = pred.op
+        if op == "and":
+            return _selectivity(pred.args[0], stats) * _selectivity(pred.args[1], stats)
+        if op == "or":
+            a = _selectivity(pred.args[0], stats)
+            b = _selectivity(pred.args[1], stats)
+            return min(1.0, a + b - a * b)
+        if op == "not":
+            return max(0.0, 1.0 - _selectivity(pred.args[0], stats))
+        if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            col, const, flipped = _col_const(pred, stats)
+            if flipped:  # const <op> col  ==  col <flip(op)> const
+                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
+            if op == "eq":
+                if col is not None and col.ndv:
+                    return min(1.0, 1.0 / col.ndv)
+                return 0.1
+            if op == "ne":
+                if col is not None and col.ndv:
+                    return max(0.0, 1.0 - 1.0 / col.ndv)
+                return 0.9
+            # range predicates: interpolate within [min, max]
+            if col is not None and const is not None and col.min is not None and col.max is not None and col.max > col.min:
+                frac = (const - col.min) / (col.max - col.min)
+                frac = min(1.0, max(0.0, frac))
+                return frac if op in ("lt", "le") else 1.0 - frac
+            return _DEFAULT_FILTER_SEL
+        if op == "is_null":
+            col, _, _ = _col_const(pred, stats)
+            return col.null_fraction if col is not None else 0.05
+    if isinstance(pred, InListIr):
+        col = (
+            stats.columns.get(pred.operand.index)
+            if isinstance(pred.operand, FieldRef)
+            else None
+        )
+        if col is not None and col.ndv:
+            sel = min(1.0, len(pred.values) / col.ndv)
+        else:
+            sel = min(1.0, 0.1 * len(pred.values))
+        return 1.0 - sel if pred.negated else sel
+    if isinstance(pred, LikeIr):
+        return 0.25 if not pred.negated else 0.75
+    return _DEFAULT_FILTER_SEL
+
+
+def _uncast(e: IrExpr) -> IrExpr:
+    # see through casts of plain column refs (decimal coercion wraps them)
+    while isinstance(e, Call) and e.op == "cast" and len(e.args) == 1:
+        e = e.args[0]
+    return e
+
+
+def _col_const(pred: Call, stats: PlanStats):
+    """(column stats, numeric constant, flipped) for col <op> const shapes,
+    either side, seeing through coercion casts; flipped=True means the
+    column was on the RIGHT (const <op> col), so range ops must mirror.
+
+    NOTE: range interpolation compares the constant against the column's
+    min/max in LANE units — for decimals both are scaled ints of the same
+    scale (casts rescale the const at fold time), so the fraction is right.
+    """
+    a = _uncast(pred.args[0])
+    b = _uncast(pred.args[1]) if len(pred.args) > 1 else None
+    col = const = None
+    flipped = False
+    if isinstance(a, FieldRef):
+        col = stats.columns.get(a.index)
+        if isinstance(b, Const) and isinstance(b.value, (int, float)):
+            const = float(b.value)
+    elif isinstance(b, FieldRef):
+        flipped = True
+        col = stats.columns.get(b.index)
+        if isinstance(a, Const) and isinstance(a.value, (int, float)):
+            const = float(a.value)
+    return col, const, flipped

@@ -138,11 +138,35 @@ class Coordinator:
 
     def _run(self, record: dict) -> None:
         sm: QueryStateMachine = record["sm"]
+        # full statement surface on the coordinator (reference: the
+        # DataDefinitionTask family executes DDL coordinator-side while
+        # embedded SELECTs run through the distributed scheduler)
+        if isinstance(record["sql"], str):
+            from ..sql import statements as S
+
+            try:
+                stmt = S.parse_statement(record["sql"])
+            except Exception:
+                stmt = None  # let the query path report the syntax error
+            if stmt is not None and not isinstance(stmt, S.QueryStmt):
+                try:
+                    sm.transition("PLANNING")
+                    sm.transition("RUNNING")
+                    rows = _statement_surface(self).execute_stmt(stmt)
+                    record["result"] = rows
+                    record["columns"] = (
+                        [f"col{i}" for i in range(len(rows[0]))] if rows else ["result"]
+                    )
+                    sm.transition("FINISHED")
+                except Exception as e:
+                    traceback.print_exc()
+                    sm.fail(str(e))
+                return
         retries = 1 if self.session.get("retry_policy") == "QUERY" else 0
         for attempt in range(retries + 1):
             try:
                 sm.transition("PLANNING")
-                self._run_once(record)
+                self._run_once(record, attempt)
                 sm.transition("FINISHED")
                 return
             except Exception as e:
@@ -152,7 +176,20 @@ class Coordinator:
                 sm.fail(str(e))
                 return
 
-    def _run_once(self, record: dict) -> None:
+    def _run_once(self, record: dict, attempt: int = 0) -> None:
+        """One execution attempt.
+
+        Scheduling modes (reference: execution/scheduler/policy/):
+        - default: ALL-AT-ONCE — every stage's tasks are posted up front
+          (task POST is non-blocking); workers long-poll their sources'
+          token-sequenced buffers, so stages overlap like the reference's
+          pipelined scheduler.  Task failures fail fast.
+        - retry_policy=TASK: PHASED — stages run children-first with a
+          barrier, and each task is individually re-scheduled on another
+          alive worker on failure (the FTE scheduler's task-level retry,
+          EventDrivenFaultTolerantQueryScheduler: possible here because
+          completed stage outputs stay buffered on their workers).
+        """
         sm: QueryStateMachine = record["sm"]
         workers = self.alive_workers()
         if not workers:
@@ -174,51 +211,253 @@ class Coordinator:
             for child in f.inputs:
                 consumer_of[child] = f.id
 
+        phased = self.session.get("retry_policy") == "TASK"
         task_urls: dict[int, list[tuple[str, str]]] = {}  # frag -> [(url, task_id)]
+        frag_meta: dict[int, tuple[dict, str]] = {}  # frag -> (payload_base, tag)
+        all_tasks: list[tuple[str, str]] = []
+        heal_seq = [0]
+
+        def heal(fid: int) -> bool:
+            """Re-run fragment `fid`'s tasks whose workers died, children
+            first (a dead worker loses its buffered stage outputs, so the
+            deterministic task is recomputed on a live node — the FTE
+            scheduler's recovery, possible here because phased mode keeps
+            every completed stage's chunks un-acked on its worker).
+            Returns True if any task moved."""
+            f = frag_by_id[fid]
+            moved = False
+            for child in f.inputs:
+                moved |= heal(child)
+            urls_list = task_urls.get(fid)
+            if urls_list is None:
+                return moved
+            dead = [i for i, (u, _) in enumerate(urls_list) if not self._worker_alive(u)]
+            for i in dead:
+                heal_seq[0] += 1
+                alive = [
+                    w for w in self.alive_workers() if w != urls_list[i][0]
+                ] or self.alive_workers()
+                if not alive:
+                    raise RuntimeError("no alive workers to heal stage")
+                payload_base_h, tag_h = frag_meta[fid]
+                w = alive[(i + heal_seq[0]) % len(alive)]
+                tid = f"{tag_h}_p{i}_h{heal_seq[0]}"
+                payload = dict(
+                    payload_base_h,
+                    sources=self._sources_payload(f, frag_by_id, task_urls),
+                    task_id=tid,
+                    part=i,
+                )
+                all_tasks.append((w, tid))
+                self._post_task(w, payload)
+                state = self._wait_task(w, tid)
+                if state != "FINISHED":
+                    raise RuntimeError(f"healed task {tid} ended {state} on {w}")
+                urls_list[i] = (w, tid)
+                moved = True
+            return moved
+
         sm.transition("RUNNING")
-        for f in sorted(fragments, key=lambda f: -f.id):
-            if f.output_kind == "result":
-                continue  # runs on coordinator below
-            out_parts = ntasks[consumer_of[f.id]]
-            sources = self._sources_payload(f, frag_by_id, task_urls)
-            payload_base = {
-                "fragment": plan_to_json(f.root),
-                "output_kind": f.output_kind,
-                "output_keys": [_encode(k) for k in f.output_keys],
-                "out_parts": out_parts,
-                "num_parts": ntasks[f.id],
-                "sources": sources,
-            }
-            urls = []
-            with ThreadPoolExecutor(max_workers=max(ntasks[f.id], 1)) as pool:
-                futs = []
-                for p in range(ntasks[f.id]):
-                    w = workers[p % nw]
-                    task_id = f"{sm.query_id}_f{f.id}_p{p}"
-                    payload = dict(payload_base, task_id=task_id, part=p)
-                    futs.append(pool.submit(self._post_task, w, payload))
-                    urls.append((w, task_id))
-                for fut in futs:
-                    fut.result()  # raises on task failure
-            task_urls[f.id] = urls
+        try:
+            for f in sorted(fragments, key=lambda f: -f.id):
+                if f.output_kind == "result":
+                    continue  # runs on coordinator below
+                out_parts = ntasks[consumer_of[f.id]]
+                sources = self._sources_payload(f, frag_by_id, task_urls)
+                payload_base = {
+                    "fragment": plan_to_json(f.root),
+                    "output_kind": f.output_kind,
+                    "output_keys": [_encode(k) for k in f.output_keys],
+                    "out_parts": out_parts,
+                    "num_parts": ntasks[f.id],
+                    "sources": sources,
+                    # re-scheduled consumers must re-read sources from token
+                    # 0, so TASK retry keeps producer chunks un-acked
+                    "ack_sources": not phased,
+                }
+                tag = f"{sm.query_id}_a{attempt}_f{f.id}"
+                frag_meta[f.id] = (payload_base, tag)
+                if phased:
 
-        # result fragment on the coordinator (COORDINATOR_DISTRIBUTION)
-        root = frag_by_id[0]
-        executor = LocalExecutor(self.catalogs, self.default_catalog)
-        remote_pages: dict[int, Page] = {}
-        from ..data.types import parse_type
+                    def refresh_sources(f=f):
+                        # a consumer task may have failed because a SOURCE
+                        # worker died mid-query: recompute the producers it
+                        # lost, then hand back the refreshed source URLs
+                        for child in f.inputs:
+                            heal(child)
+                        return self._sources_payload(f, frag_by_id, task_urls)
 
-        for child_id in root.inputs:
-            child = frag_by_id[child_id]
-            kind = child.output_kind
-            blobs = []
-            for (u, t) in task_urls[child_id]:
-                buffer_id = 0  # result stage is single-partition
-                blobs.append(_http_get(f"{u}/v1/task/{t}/results/{buffer_id}/0"))
-            remote_pages[child_id] = wire_to_page(blobs, list(child.root.output_types))
-        sm.transition("FINISHING")
-        page = executor.execute(root.root, remote_pages)
-        record["result"] = page.to_pylist()
+                    urls = self._run_stage_phased(
+                        payload_base,
+                        ntasks[f.id],
+                        tag,
+                        max_attempts=int(self.session.get("task_retry_attempts")),
+                        posted=all_tasks,  # every posted task gets cleaned up
+                        refresh_sources=refresh_sources,
+                    )
+                else:
+                    urls = []
+                    for p in range(ntasks[f.id]):
+                        w = workers[p % nw]
+                        task_id = f"{tag}_p{p}"
+                        all_tasks.append((w, task_id))  # before post: no leak
+                        self._post_task(w, dict(payload_base, task_id=task_id, part=p))
+                        urls.append((w, task_id))
+                task_urls[f.id] = urls
+
+            # result fragment on the coordinator (COORDINATOR_DISTRIBUTION)
+            from .worker import _stream_fetch
+
+            root = frag_by_id[0]
+            executor = LocalExecutor(self.catalogs, self.default_catalog)
+            remote_pages: dict[int, Page] = {}
+            for child_id in root.inputs:
+                child = frag_by_id[child_id]
+                blobs: list[bytes] = []
+                for i in range(len(task_urls[child_id])):
+                    u, t = task_urls[child_id][i]
+                    try:
+                        blobs.extend(_stream_fetch(u, t, 0))
+                    except Exception as e:
+                        if not phased:
+                            raise RuntimeError(self._failure_detail(all_tasks, e))
+                        # producer died between finishing and our fetch:
+                        # recompute it (and anything it lost) and re-read
+                        heal(child_id)
+                        u, t = task_urls[child_id][i]
+                        try:
+                            blobs.extend(_stream_fetch(u, t, 0))
+                        except Exception as e2:
+                            raise RuntimeError(self._failure_detail(all_tasks, e2))
+                remote_pages[child_id] = wire_to_page(
+                    blobs, list(child.root.output_types)
+                )
+            sm.transition("FINISHING")
+            page = executor.execute(root.root, remote_pages)
+            record["result"] = page.to_pylist()
+        finally:
+            self._cleanup_tasks(all_tasks)
+
+    def _run_stage_phased(
+        self,
+        payload_base: dict,
+        nparts: int,
+        tag: str,
+        max_attempts: int = 3,
+        posted: Optional[list] = None,
+        refresh_sources=None,
+    ) -> list[tuple[str, str]]:
+        """Post one stage's tasks, poll statuses, and re-schedule individual
+        failures onto other alive workers (task-level recovery).  Every
+        posted (worker, task_id) is appended to `posted` so cleanup covers
+        failed stages too.  refresh_sources() is called before each
+        re-schedule: it heals dead SOURCE producers and returns the updated
+        sources payload, so a retry doesn't re-fetch from a dead URL."""
+        workers = self.alive_workers()
+        urls: list[Optional[tuple[str, str]]] = [None] * nparts
+        attempts = [0] * nparts
+        pending: dict[int, tuple[str, str]] = {}
+
+        def try_post(p: int, w: str, task_id: str) -> bool:
+            if posted is not None:
+                posted.append((w, task_id))
+            try:
+                self._post_task(w, dict(payload_base, task_id=task_id, part=p))
+                return True
+            except Exception:
+                return False  # dead/unreachable worker: reschedule below
+
+        for p in range(nparts):
+            w = workers[p % len(workers)]
+            task_id = f"{tag}_p{p}_t0"
+            try_post(p, w, task_id)
+            pending[p] = (w, task_id)
+        while pending:
+            done: list[int] = []
+            with ThreadPoolExecutor(max_workers=max(len(pending), 1)) as pool:
+                futs = {
+                    p: pool.submit(self._task_status, u, t, 5.0)
+                    for p, (u, t) in pending.items()
+                }
+            for p, fut in futs.items():
+                state = fut.result()
+                if state == "FINISHED":
+                    urls[p] = pending[p]
+                    done.append(p)
+                elif state in ("FAILED", "UNKNOWN", "UNREACHABLE"):
+                    attempts[p] += 1
+                    if attempts[p] >= max_attempts:
+                        raise RuntimeError(
+                            f"task {pending[p][1]} failed {attempts[p]} times"
+                        )
+                    bad_url = pending[p][0]
+                    alive = [w for w in self.alive_workers() if w != bad_url]
+                    if not alive:
+                        alive = self.alive_workers()
+                    if not alive:
+                        raise RuntimeError("no alive workers for re-schedule")
+                    if refresh_sources is not None:
+                        payload_base = dict(
+                            payload_base, sources=refresh_sources()
+                        )
+                    w = alive[(p + attempts[p]) % len(alive)]
+                    task_id = f"{tag}_p{p}_t{attempts[p]}"
+                    try_post(p, w, task_id)
+                    pending[p] = (w, task_id)
+            for p in done:
+                del pending[p]
+        return urls  # type: ignore[return-value]
+
+    def _worker_alive(self, url: str, timeout: float = 3.0) -> bool:
+        try:
+            with urllib.request.urlopen(f"{url}/v1/info", timeout=timeout) as r:
+                r.read()
+            return True
+        except Exception:
+            return False
+
+    def _wait_task(self, worker_url: str, task_id: str, timeout: float = 600.0) -> str:
+        """Poll a task to a terminal state (long-poll increments of 5s)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            state = self._task_status(worker_url, task_id, 5.0)
+            if state in ("FINISHED", "FAILED", "UNKNOWN", "UNREACHABLE"):
+                return state
+        return "TIMEOUT"
+
+    def _task_status(self, worker_url: str, task_id: str, wait: float) -> str:
+        try:
+            with urllib.request.urlopen(
+                f"{worker_url}/v1/task/{task_id}/status?wait={wait}", timeout=wait + 10
+            ) as r:
+                return json.loads(r.read()).get("state", "UNKNOWN")
+        except Exception:
+            return "UNREACHABLE"
+
+    def _failure_detail(self, all_tasks, base_exc: Exception) -> str:
+        """Sweep task statuses for the root cause of a fetch failure."""
+        for (u, t) in all_tasks:
+            try:
+                with urllib.request.urlopen(
+                    f"{u}/v1/task/{t}/status", timeout=5
+                ) as r:
+                    st = json.loads(r.read())
+                if st.get("state") == "FAILED":
+                    return f"task {t} failed on {u}: {st.get('error')}"
+            except Exception:
+                continue
+        return str(base_exc)
+
+    def _cleanup_tasks(self, all_tasks) -> None:
+        for (u, t) in all_tasks:
+            try:
+                req = urllib.request.Request(f"{u}/v1/task/{t}", method="DELETE")
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    r.read()
+            except Exception:
+                pass
 
     def _sources_payload(self, f: Fragment, frag_by_id, task_urls) -> dict:
         out = {}
@@ -239,16 +478,83 @@ class Coordinator:
             headers={"Content-Type": "application/json"},
         )
         try:
-            with urllib.request.urlopen(req, timeout=600) as r:
+            with urllib.request.urlopen(req, timeout=30) as r:
                 r.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
-            raise RuntimeError(f"task {payload['task_id']} failed on {worker_url}: {detail}")
+            raise RuntimeError(
+                f"task {payload['task_id']} rejected by {worker_url}: {detail}"
+            )
 
 
-def _http_get(url: str) -> bytes:
-    with urllib.request.urlopen(url, timeout=60) as r:
-        return r.read()
+# --------------------------------------------------- statement surface shim
+
+
+def _statement_surface(coord: "Coordinator"):
+    from .engine import Engine
+
+    class _StatementSurface(Engine):
+        """The Engine statement executor with its two query primitives
+        rebound to the multi-host scheduler: `query` runs the SELECT
+        distributed, and `_query_columns` rebuilds host columns (with
+        validity) from the distributed result rows for the write path."""
+
+        def __init__(self):
+            # no super().__init__: that would build a second local executor
+            self._coord = coord
+            self.catalogs = coord.catalogs
+            self.default_catalog = coord.default_catalog
+            self.planner = coord.planner
+            self.executor = None  # queries never execute locally here
+            self.distributed = True
+            self.session = coord.session
+            from .events import EventListenerManager
+
+            self.events = EventListenerManager()
+            self._query_seq = 0
+
+        def plan(self, sql_or_query):
+            return optimize(self.planner.plan(sql_or_query))
+
+        def query(self, sql_or_query) -> list[tuple]:
+            return self._coord.execute_query(sql_or_query)
+
+        def _query_columns(self, query):
+            plan = self.plan(query)
+            rows = self.query(query)
+            types = list(plan.output_types)
+            return list(plan.output_names), types, _rows_to_columns(rows, types)
+
+    return _StatementSurface()
+
+
+def _rows_to_columns(rows: list[tuple], types: list):
+    """Client-protocol rows (python values, None == NULL) -> host column
+    arrays in lane representation (decimals re-scale to int64, dates to day
+    counts), MaskedArray where NULLs are present."""
+    import numpy as np
+
+    from ..data.types import date_to_days
+
+    out = []
+    for i, t in enumerate(types):
+        vals = [r[i] for r in rows]
+        nulls = np.array([v is None for v in vals], dtype=bool)
+
+        def lane(v):
+            if v is None:
+                return "" if t.is_string else 0
+            if t.is_decimal:
+                return int(round(v * (10 ** t.scale)))
+            if t.name == "date" and isinstance(v, str):
+                return date_to_days(v)
+            return v
+
+        arr = np.asarray(
+            [lane(v) for v in vals], dtype=object if t.is_string else t.np_dtype
+        )
+        out.append(np.ma.MaskedArray(arr, mask=nulls) if nulls.any() else arr)
+    return out
 
 
 # ------------------------------------------------------------ HTTP protocol

@@ -50,9 +50,17 @@ class Engine:
             self.executor = LocalExecutor(self.catalogs, default_catalog)
         self.distributed = distributed
         self.session = SessionProperties()
+        from .events import EventListenerManager
+
+        self.events = EventListenerManager()
+        self._query_seq = 0
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
+
+    def add_event_listener(self, listener) -> None:
+        """Reference: EventListener SPI (eventlistener/EventListenerManager)."""
+        self.events.add(listener)
 
     # ------------------------------------------------------------- queries
     def plan(self, sql_or_query) -> PlanNode:
@@ -71,11 +79,59 @@ class Engine:
         return format_plan(self.plan(sql))
 
     def execute_page(self, sql) -> Page:
-        return self.executor.execute(self.plan(sql))
+        plan = self.plan(sql)
+        budget = int(self.session.get("query_max_memory_bytes") or 0)
+        if budget and not self.distributed:
+            from ..exec.spill import OutOfCoreExecutor, estimate_plan_bytes
+            from .memory import MemoryExceeded
+
+            est = estimate_plan_bytes(plan, self.catalogs)
+            if est > budget:
+                parts = max(2, min(16, -(-est // budget)))
+                parts = 1 << (parts - 1).bit_length()  # pow2 slices, capped:
+                # beyond 16 the per-slice compile overhead dominates any
+                # memory win (deeper budgets should spill to bigger disks,
+                # not thinner slices)
+                ooc = OutOfCoreExecutor(
+                    self.catalogs, self.default_catalog, parts, self.session
+                )
+                self.last_spill = ooc  # observable: spilled_bytes/spill_files
+                return ooc.execute(plan)
+        return self.executor.execute(plan)
 
     def query(self, sql) -> list[tuple]:
         """Run a query, return rows as python tuples (None == NULL)."""
-        return self.execute_page(sql).to_pylist()
+        from .events import QueryEvent
+
+        self._query_seq += 1
+        qid = f"local_{self._query_seq}"
+        text = sql if isinstance(sql, str) else "<planned>"
+        self.events.fire(QueryEvent("created", qid, text))
+        t0 = _time.perf_counter()
+        try:
+            rows = self.execute_page(sql).to_pylist()
+        except Exception as e:
+            self.events.fire(
+                QueryEvent("failed", qid, text, _time.perf_counter() - t0, error=str(e))
+            )
+            raise
+        self.events.fire(
+            QueryEvent("completed", qid, text, _time.perf_counter() - t0, rows=len(rows))
+        )
+        return rows
+
+    def _query_columns(self, query) -> tuple[list, list, list]:
+        """(names, types, host column arrays) of a query result — the write
+        path's input.  Overridable: the multi-host coordinator rebuilds the
+        columns from its distributed result rows instead (runtime/
+        coordinator.py _StatementSurface)."""
+        plan = self.plan(query)
+        page = self.executor.execute(plan)
+        return (
+            list(plan.output_names),
+            list(plan.output_types),
+            page.to_numpy_columns(),
+        )
 
     # ---------------------------------------------------- statement surface
     def execute(self, sql: str) -> list[tuple]:
@@ -83,7 +139,10 @@ class Engine:
         SHOW TABLES, DESCRIBE, SET SESSION."""
         from ..sql import statements as S
 
-        stmt = S.parse_statement(sql)
+        return self.execute_stmt(S.parse_statement(sql))
+
+    def execute_stmt(self, stmt) -> list[tuple]:
+        from ..sql import statements as S
 
         if isinstance(stmt, S.QueryStmt):
             return self.query(stmt.query)
@@ -93,7 +152,32 @@ class Engine:
             if not stmt.analyze:
                 return [(line,) for line in format_plan(plan).splitlines()]
             t0 = _time.perf_counter()
-            rows = self.executor.execute(plan).to_pylist()
+            if not self.distributed and hasattr(self.executor, "explain_analyze"):
+                page, stats = self.executor.explain_analyze(plan)
+                wall = _time.perf_counter() - t0
+                ann = {
+                    nid: (
+                        f"   [rows: {s.get('rows', '?')}"
+                        + (f", {s['ms']:.1f} ms" if "ms" in s else "")
+                        + "]"
+                    )
+                    for nid, s in stats.items()
+                }
+                text = format_plan(plan, annotations=ann).splitlines()
+                timed = [(nid, s["ms"]) for nid, s in stats.items() if "ms" in s]
+                if timed:
+                    slow_nid, slow_ms = max(timed, key=lambda kv: kv[1])
+                    from ..exec.compiler import _node_ids
+
+                    slow = type(_node_ids(plan)[slow_nid]).__name__
+                    text.append(
+                        f"-- slowest operator: {slow} (node {slow_nid}, {slow_ms:.1f} ms eager)"
+                    )
+                text.append(
+                    f"-- output rows: {len(page.to_pylist())}, wall: {wall * 1000:.1f} ms"
+                )
+                return [(line,) for line in text]
+            rows = self.query(stmt.query)
             wall = _time.perf_counter() - t0
             text = format_plan(plan).splitlines()
             text.append(f"-- output rows: {len(rows)}, wall: {wall * 1000:.1f} ms")
@@ -102,41 +186,37 @@ class Engine:
         if isinstance(stmt, S.CreateTable):
             from ..data.types import parse_type
 
-            conn = self.catalogs.get(self.default_catalog)
-            if stmt.if_not_exists and stmt.name in conn.list_tables():
+            conn, name = self._target_conn(stmt.name)
+            if stmt.if_not_exists and name in conn.list_tables():
                 return [(0,)]
             conn.create_table(
-                stmt.name, [ColumnSchema(n, parse_type(t)) for n, t in stmt.columns]
+                name, [ColumnSchema(n, parse_type(t)) for n, t in stmt.columns]
             )
             return [(0,)]
 
         if isinstance(stmt, S.CreateTableAs):
-            conn = self.catalogs.get(self.default_catalog)
-            if stmt.if_not_exists and stmt.name in conn.list_tables():
+            conn, name = self._target_conn(stmt.name)
+            if stmt.if_not_exists and name in conn.list_tables():
                 return [(0,)]
-            plan = self.plan(stmt.query)
-            page = self.executor.execute(plan)
-            cols = page.to_numpy_columns()
+            names, types, cols = self._query_columns(stmt.query)
             conn.create_table(
-                stmt.name,
-                [ColumnSchema(n, t) for n, t in zip(plan.output_names, plan.output_types)],
+                name, [ColumnSchema(n, t) for n, t in zip(names, types)]
             )
-            n = conn.insert(stmt.name, dict(zip(plan.output_names, cols)))
+            n = conn.insert(name, dict(zip(names, cols)))
             return [(n,)]
 
         if isinstance(stmt, S.Insert):
-            plan = self.plan(stmt.query)
-            page = self.executor.execute(plan)
-            return [(self._insert(stmt.table, stmt.columns, page),)]
+            _, _, cols = self._query_columns(stmt.query)
+            return [(self._insert(stmt.table, stmt.columns, cols),)]
 
         if isinstance(stmt, S.InsertValues):
             return [(self._insert_values(stmt),)]
 
         if isinstance(stmt, S.DropTable):
-            conn = self.catalogs.get(self.default_catalog)
-            if stmt.if_exists and stmt.name not in conn.list_tables():
+            conn, name = self._target_conn(stmt.name)
+            if stmt.if_exists and name not in conn.list_tables():
                 return [(0,)]
-            conn.drop_table(stmt.name)
+            conn.drop_table(name)
             return [(0,)]
 
         if isinstance(stmt, S.ShowTables):
@@ -144,8 +224,8 @@ class Engine:
             return [(t,) for t in conn.list_tables()]
 
         if isinstance(stmt, S.DescribeTable):
-            conn = self.catalogs.get(self.default_catalog)
-            schema = conn.table_schema(stmt.name)
+            conn, name = self._target_conn(stmt.name)
+            schema = conn.table_schema(name)
             return [(c.name, c.type.name) for c in schema.columns]
 
         if isinstance(stmt, S.SetSession):
@@ -154,11 +234,24 @@ class Engine:
 
         raise NotImplementedError(f"statement {type(stmt).__name__}")
 
+    def _target_conn(self, name: str):
+        """Resolve a possibly `catalog.table`-qualified DDL/DML target
+        (Trino 2-part semantics: an unknown first part falls back to a plain
+        table name in the default catalog)."""
+        if "." in name:
+            parts = name.split(".")
+            try:
+                # catalog.table or catalog.schema.table (schema is vestigial:
+                # connectors here are single-schema)
+                return self.catalogs.get(parts[0]), parts[-1]
+            except KeyError:
+                pass
+        return self.catalogs.get(self.default_catalog), name
+
     # ------------------------------------------------------------ write path
-    def _insert(self, table: str, columns, page: Page) -> int:
-        conn = self.catalogs.get(self.default_catalog)
+    def _insert(self, table: str, columns, cols: list) -> int:
+        conn, table = self._target_conn(table)
         schema = conn.table_schema(table)
-        cols = page.to_numpy_columns()
         names = list(columns) if columns else [c.name for c in schema.columns]
         if len(names) != len(cols):
             raise ValueError(f"INSERT column count mismatch: {len(names)} vs {len(cols)}")
@@ -183,17 +276,21 @@ class Engine:
         from ..plan.ir import Const
         from ..plan.planner import Scope, _Translator
 
-        conn = self.catalogs.get(self.default_catalog)
-        schema = conn.table_schema(stmt.table)
+        conn, table = self._target_conn(stmt.table)
+        schema = conn.table_schema(table)
         names = list(stmt.columns) if stmt.columns else [c.name for c in schema.columns]
+        from ..plan.planner import _cast_ir
+
         t = _Translator(Scope([]))
         rows = []
         for row in stmt.rows:
             vals = []
-            for e in row:
+            for ci, e in enumerate(row):
                 ir = t.translate(e)
                 if not isinstance(ir, Const):
                     raise ValueError(f"INSERT VALUES must be literals: {e}")
+                # coerce to the column type (e.g. 1.5 -> scaled decimal lanes)
+                ir = _cast_ir(ir, schema.type_of(names[ci]))
                 vals.append(ir.value)
             rows.append(vals)
         n = len(rows)
@@ -218,4 +315,4 @@ class Engine:
                 data[c.name] = np.zeros(
                     (n,), dtype=object if c.type.is_string else c.type.np_dtype
                 )
-        return conn.insert(stmt.table, data)
+        return conn.insert(table, data)

@@ -1,0 +1,77 @@
+"""Memory accounting: hierarchical byte budgets for device residency.
+
+Reference: lib/trino-memory-context (LocalMemoryContext.java:18,31 —
+setBytes returns a future that blocks the driver when the pool is full;
+AggregatedMemoryContext.java:16 rolls children up) and
+memory/ClusterMemoryManager.java:92 (pool enforcement + OOM kill).
+
+TPU shape: HBM reservations are made by the executor BEFORE uploading
+table columns or allocating operator capacities, from *static* estimates
+(capacities are static by design — the capacity protocol makes operator
+footprints knowable up front, something the reference's growable hash
+tables cannot do).  Exceeding the budget raises MemoryExceeded, which the
+engine catches to re-plan with the out-of-core partitioned executor
+(exec/spill.py) — the moral analogue of the reference's revocable memory +
+spill path (SpillableHashAggregationBuilder.java:55).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["MemoryExceeded", "MemoryContext", "QueryMemoryPool"]
+
+
+class MemoryExceeded(RuntimeError):
+    def __init__(self, requested: int, used: int, budget: int, what: str = ""):
+        self.requested = requested
+        self.used = used
+        self.budget = budget
+        super().__init__(
+            f"memory budget exceeded: need {requested} bytes ({what}), "
+            f"used {used} of {budget}"
+        )
+
+
+class QueryMemoryPool:
+    """One query's byte pool (reference: per-query MemoryPool slice)."""
+
+    def __init__(self, budget: Optional[int]):
+        self.budget = budget  # None = unlimited
+        self.used = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def reserve(self, nbytes: int, what: str = "") -> None:
+        with self._lock:
+            if self.budget is not None and self.used + nbytes > self.budget:
+                raise MemoryExceeded(nbytes, self.used, self.budget, what)
+            self.used += nbytes
+            self.peak = max(self.peak, self.used)
+
+    def free(self, nbytes: int) -> None:
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+
+
+class MemoryContext:
+    """Named child of a pool (reference: LocalMemoryContext under an
+    AggregatedMemoryContext); tracks its own reservation so set() is
+    idempotent-adjusting like the reference's setBytes."""
+
+    def __init__(self, pool: QueryMemoryPool, name: str):
+        self.pool = pool
+        self.name = name
+        self.reserved = 0
+
+    def set(self, nbytes: int) -> None:
+        delta = nbytes - self.reserved
+        if delta > 0:
+            self.pool.reserve(delta, self.name)
+        else:
+            self.pool.free(-delta)
+        self.reserved = nbytes
+
+    def close(self) -> None:
+        self.set(0)

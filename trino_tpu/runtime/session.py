@@ -50,11 +50,25 @@ PROPERTIES: dict[str, _Prop] = {
         ),
         _Prop(
             "retry_policy", str, "NONE",
-            "NONE | QUERY — query-level retry on worker failure "
-            "(reference: RetryPolicy)",
-            lambda v: v in ("NONE", "QUERY"),
+            "NONE | QUERY | TASK — QUERY retries the whole query once; TASK "
+            "runs stages phased with per-task re-scheduling onto other "
+            "alive workers (reference: RetryPolicy + the FTE scheduler)",
+            lambda v: v in ("NONE", "QUERY", "TASK"),
+        ),
+        _Prop(
+            "task_retry_attempts", int, 3,
+            "max attempts per task under retry_policy=TASK",
+            lambda v: v >= 1,
         ),
         _Prop("explain_format", str, "text", "text | json", None),
+        _Prop(
+            "query_max_memory_bytes", int, 0,
+            "device-memory budget per query; 0 = unlimited.  Queries whose "
+            "estimated working set exceeds it run out-of-core: partitioned "
+            "into sequential slices with disk-spilled exchanges "
+            "(exec/spill.py; reference: spiller/ + revocable memory)",
+            lambda v: v >= 0,
+        ),
     ]
 }
 

@@ -7,7 +7,6 @@ receiving task — the reference's PagesSerdes + PositionsAppender path
 
 from __future__ import annotations
 
-import hashlib
 from typing import Sequence
 
 import numpy as np
@@ -18,7 +17,14 @@ from ..native import page_serde
 from ..ops.expr import column_val, eval_expr
 from ..plan.ir import IrExpr
 
-__all__ = ["page_to_wire", "wire_to_page", "partition_page"]
+__all__ = [
+    "page_to_wire", "page_to_wire_chunks", "wire_to_page", "partition_page",
+]
+
+# Target rows per wire chunk: bounds single HTTP transfers and lets the
+# consumer acknowledge-and-free incrementally (the reference bounds transfer
+# by bytes via exchange.max-response-size; rows are our natural unit).
+CHUNK_ROWS = 262_144
 
 
 def _host_columns(page: Page) -> tuple[list[np.ndarray], list, np.ndarray]:
@@ -53,9 +59,47 @@ def page_to_wire(page: Page, row_mask: np.ndarray = None) -> bytes:
     return page_serde().serialize_columns(cols)
 
 
-def wire_to_page(blobs: Sequence[bytes], types: Sequence[Type]) -> Page:
+def page_to_wire_chunks(page: Page, chunk_rows: int = 0) -> list[bytes]:
+    """Serialize a page as a sequence of independently-deserializable wire
+    chunks of <= chunk_rows live rows each (token-addressed by index in the
+    output buffer protocol; reference: PartitionedOutputBuffer pages)."""
+    chunk_rows = chunk_rows or CHUNK_ROWS  # late-bound so tests can shrink it
+    datas, valids, idx = _host_columns(page)
+    n = len(idx)
+    nchunks = max(1, -(-n // chunk_rows))
+    out = []
+    for c in range(nchunks):
+        sl = slice(c * chunk_rows, min((c + 1) * chunk_rows, n))
+        cols: dict[str, np.ndarray] = {}
+        for i, (d, v) in enumerate(zip(datas, valids)):
+            cols[f"c{i:04d}"] = d[sl]
+            if v is not None:
+                cols[f"v{i:04d}"] = v[sl]
+        out.append(page_serde().serialize_columns(cols))
+    return out
+
+
+def _chunk_blob_columns(cols_p: dict, n: int, chunk_rows: int) -> list[bytes]:
+    nchunks = max(1, -(-n // chunk_rows))
+    out = []
+    for c in range(nchunks):
+        sl = slice(c * chunk_rows, min((c + 1) * chunk_rows, n))
+        out.append(
+            page_serde().serialize_columns({k: v[sl] for k, v in cols_p.items()})
+        )
+    return out
+
+
+def wire_to_page(
+    blobs: Sequence[bytes], types: Sequence[Type], pad_pow2: bool = False
+) -> Page:
     """Concatenate wire pages from multiple producers into one device page.
-    Empty inputs produce a 1-row all-dead page (kernels need capacity >= 1)."""
+    Empty inputs produce a 1-row all-dead page (kernels need capacity >= 1).
+
+    pad_pow2 pads the capacity to the next power of two with dead rows so
+    repeated executions over varying input sizes collapse into O(log n)
+    compiled shape classes (the out-of-core executor runs P slices through
+    one jit cache this way)."""
     serde = page_serde()
     parts = [serde.deserialize_columns(b) for b in blobs]
     total = sum(
@@ -75,6 +119,9 @@ def wire_to_page(blobs: Sequence[bytes], types: Sequence[Type]) -> Page:
         import jax.numpy as _jnp
 
         return Page(tuple(cols), _jnp.zeros((1,), _jnp.bool_))
+    cap = total
+    if pad_pow2:
+        cap = 1 << max(0, (total - 1).bit_length())
     columns: list[Column] = []
     for i, t in enumerate(types):
         datas = [p[f"c{i:04d}"] for p in parts if f"c{i:04d}" in p]
@@ -98,16 +145,30 @@ def wire_to_page(blobs: Sequence[bytes], types: Sequence[Type]) -> Page:
             if valid is not None and len(data):
                 data = data.copy()
                 data[~valid] = ""
+        if cap > n:
+            fill = np.zeros((cap - n,), dtype=object if t.is_string else t.np_dtype)
+            if t.is_string:
+                fill[:] = ""
+            data = np.concatenate([data, fill])
+            if valid is not None:
+                valid = np.concatenate([valid, np.zeros(cap - n, np.bool_)])
         columns.append(Column.from_numpy(t, data, valid))
-    return Page(tuple(columns))
+    live = None
+    if cap > total:
+        import jax.numpy as _jnp
+
+        live = _jnp.arange(cap, dtype=_jnp.int32) < total
+    return Page(tuple(columns), live)
 
 
 def partition_page(
-    page: Page, keys: Sequence[IrExpr], nparts: int
-) -> list[bytes]:
-    """Hash-route rows into nparts wire pages (reference: PagePartitioner.
-    partitionPage:135).  VARCHAR keys hash by dictionary VALUE (stable across
-    tasks whose dictionaries differ)."""
+    page: Page, keys: Sequence[IrExpr], nparts: int, chunk_rows: int = 0
+) -> list[list[bytes]]:
+    """Hash-route rows into nparts sequences of wire chunks (reference:
+    PagePartitioner.partitionPage:135 feeding PartitionedOutputBuffer).
+    VARCHAR keys hash by dictionary VALUE (stable across tasks whose
+    dictionaries differ)."""
+    chunk_rows = chunk_rows or CHUNK_ROWS  # late-bound so tests can shrink it
     cap = page.capacity
     cols = [column_val(c) for c in page.columns]
     live = np.asarray(page.live_mask())
@@ -120,11 +181,12 @@ def partition_page(
         if kv.valid is not None:
             keys_ok &= np.asarray(kv.valid)
         if kv.dict is not None:
-            table = np.asarray(
-                [_str_hash64(v) for v in kv.dict.values], dtype=np.uint64
-            )
+            # Dictionary.hash64(): the shared value-hash table — must match
+            # ops/relops.py _combined_hash so host and device partitioning
+            # route equal strings identically
+            table = kv.dict.hash64()
             codes = np.asarray(kv.data)
-            bits = table[np.clip(codes, 0, max(len(table) - 1, 0))]
+            bits = table[np.clip(codes, 0, len(table) - 1)]
         else:
             data = np.asarray(kv.data)
             if np.issubdtype(data.dtype, np.floating):
@@ -149,7 +211,7 @@ def partition_page(
             cols_p[f"c{i:04d}"] = d[keep]
             if v is not None:
                 cols_p[f"v{i:04d}"] = v[keep]
-        out.append(page_serde().serialize_columns(cols_p))
+        out.append(_chunk_blob_columns(cols_p, int(keep.sum()), chunk_rows))
     return out
 
 
@@ -160,5 +222,3 @@ def _mix64_np(x: np.ndarray) -> np.ndarray:
     return x ^ (x >> np.uint64(31))
 
 
-def _str_hash64(v) -> int:
-    return int.from_bytes(hashlib.blake2b(str(v).encode(), digest_size=8).digest(), "little")

@@ -1,20 +1,33 @@
-"""Worker node: HTTP task execution server.
+"""Worker node: asynchronous HTTP task execution server.
 
-Reference wiring this replaces (SURVEY §2.8, §3.2):
-  POST /v1/task/{id}      TaskResource.createOrUpdateTask (TaskResource.java:142)
-                          carrying TaskUpdateRequest {fragment, splits,
-                          output layout} -> SqlTaskManager.updateTask:491
+Reference wiring this replaces (SURVEY §2.8, §3.2-3.3):
+  POST /v1/task/{id}          TaskResource.createOrUpdateTask
+                              (TaskResource.java:142) — returns IMMEDIATELY;
+                              the task runs on the worker's executor pool
+                              (SqlTaskManager.updateTask:491 semantics)
+  GET  /v1/task/{id}/status?wait=s
+                              long-poll task status, the reference's
+                              ContinuousTaskStatusFetcher
+                              (server/remotetask/HttpRemoteTask.java:339)
   GET  /v1/task/{id}/results/{buffer}/{token}
-                          TaskResource.java:331 (pipelined data plane)
-  DELETE /v1/task/{id}    task abort
-  GET  /v1/info           heartbeat (failuredetector/HeartbeatFailureDetector)
-  POST /v1/inject_failure test-only fault injection
-                          (reference: execution/FailureInjector.java:33,
-                          TestingTrinoServer.injectTaskFailure)
+                              token-sequenced chunked page fetch
+                              (HttpPageBufferClient.sendGetResults:355);
+                              response headers carry X-Complete /X-No-Data;
+                              re-reading a token is idempotent
+                              (at-least-once with client-side dedup)
+  GET  /v1/task/{id}/results/{buffer}/{token}/acknowledge
+                              frees chunks below `token`
+                              (HttpPageBufferClient.java:406-424)
+  DELETE /v1/task/{id}        abort + free buffers
+  GET  /v1/info               heartbeat (failuredetector/HeartbeatFailureDetector)
+  POST /v1/inject_failure     test-only fault injection
+                              (execution/FailureInjector.java:33)
 
 A task executes its fragment with the jitted LocalExecutor over its split
-range, partitions output rows per the fragment's output kind, and parks the
-wire pages in per-partition buffers for consumers to fetch.
+range, partitions output rows per the fragment's output kind into
+token-addressed chunk lists per partition buffer.  Source fetch streams
+chunk-by-chunk with acknowledge, so a consumer's in-flight HTTP memory is
+bounded by one chunk per producer even when the exchange moves gigabytes.
 """
 
 from __future__ import annotations
@@ -23,6 +36,7 @@ import json
 import threading
 import traceback
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -30,19 +44,52 @@ from ..connectors.spi import CatalogManager
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
-from .wire import page_to_wire, partition_page, wire_to_page
+from .wire import page_to_wire_chunks, partition_page, wire_to_page
 
 __all__ = ["Worker"]
 
 
+class _Task:
+    """One task's lifecycle + output buffers (reference: SqlTask.java:498)."""
+
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.state = "RUNNING"
+        self.error: Optional[str] = None
+        # buffer_id -> list of chunks (None = acknowledged/freed)
+        self.buffers: dict[int, list[Optional[bytes]]] = {}
+        self.complete = False  # all output chunks present
+        self.canceled = False
+        self.cond = threading.Condition()
+
+    def finish(self, buffers: dict[int, list[bytes]]) -> None:
+        with self.cond:
+            self.buffers = {k: list(v) for k, v in buffers.items()}
+            self.complete = True
+            self.state = "FINISHED"
+            self.cond.notify_all()
+
+    def fail(self, msg: str) -> None:
+        with self.cond:
+            self.state = "FAILED"
+            self.error = msg
+            self.cond.notify_all()
+
+
 class Worker:
-    def __init__(self, catalogs: CatalogManager, default_catalog: str, port: int = 0):
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        default_catalog: str,
+        port: int = 0,
+        task_concurrency: int = 4,
+    ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
-        self.buffers: dict[tuple[str, int], bytes] = {}
-        self.task_state: dict[str, str] = {}
+        self.tasks: dict[str, _Task] = {}
         self.injected_failures: set[str] = set()
         self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=task_concurrency)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_port
@@ -55,61 +102,188 @@ class Worker:
 
     def stop(self) -> None:
         self.httpd.shutdown()
+        self.httpd.server_close()  # close the listening socket: connection
+        # attempts fail fast instead of hanging in the kernel accept queue
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     # ------------------------------------------------------- task execution
-    def run_task(self, req: dict) -> None:
+    def submit_task(self, req: dict) -> _Task:
         task_id = req["task_id"]
-        with self._lock:  # one-shot injection tokens (FailureInjector.java:33)
-            if task_id in self.injected_failures:
-                self.injected_failures.discard(task_id)
-                raise RuntimeError(f"injected failure for task {task_id}")
-            if "*" in self.injected_failures:
-                self.injected_failures.discard("*")
-                raise RuntimeError(f"injected failure for task {task_id}")
-        fragment = plan_from_json(req["fragment"])
-        executor = LocalExecutor(self.catalogs, self.default_catalog)
-        executor.split = (req["part"], req["num_parts"])
+        task = _Task(task_id)
+        with self._lock:
+            self.tasks[task_id] = task
+        self._pool.submit(self._run_task, task, req)
+        return task
 
-        remote_pages: dict[int, Page] = {}
-        for fid_str, src in req.get("sources", {}).items():
-            fid = int(fid_str)
-            kind = src["kind"]
-            my_part = req["part"]
-            if kind == "single" and my_part != 0:
-                blobs = []
-            else:
-                buffer_id = my_part if kind == "repartition" else 0
-                blobs = [
-                    _fetch(f"{u}/v1/task/{t}/results/{buffer_id}/0")
-                    for u, t in src["tasks"]
-                ]
-            from ..data.types import parse_type
+    def _run_task(self, task: _Task, req: dict) -> None:
+        try:
+            with self._lock:  # one-shot injection (FailureInjector.java:33)
+                if task.task_id in self.injected_failures:
+                    self.injected_failures.discard(task.task_id)
+                    raise RuntimeError(f"injected failure for task {task.task_id}")
+                if "*" in self.injected_failures:
+                    self.injected_failures.discard("*")
+                    raise RuntimeError(f"injected failure for task {task.task_id}")
+            fragment = plan_from_json(req["fragment"])
+            executor = LocalExecutor(self.catalogs, self.default_catalog)
+            executor.split = (req["part"], req["num_parts"])
 
-            types = [parse_type(t) for t in src["types"]]
-            remote_pages[fid] = wire_to_page(blobs, types)
+            remote_pages: dict[int, Page] = {}
+            for fid_str, src in req.get("sources", {}).items():
+                fid = int(fid_str)
+                kind = src["kind"]
+                my_part = req["part"]
+                blobs: list[bytes] = []
+                if not (kind == "single" and my_part != 0):
+                    buffer_id = my_part if kind == "repartition" else 0
+                    # gather/broadcast/single buffers are read by EVERY
+                    # consumer task — acknowledging would free chunks under
+                    # the other readers (the reference gives each consumer
+                    # its own ClientBuffer; we share and skip the ack).
+                    # Under retry_policy=TASK the coordinator also disables
+                    # acks (ack_sources=False): a re-scheduled consumer must
+                    # be able to re-read its sources from token 0.
+                    ack = kind == "repartition" and req.get("ack_sources", True)
+                    for (u, t) in src["tasks"]:
+                        if task.canceled:
+                            raise RuntimeError("task canceled")
+                        blobs.extend(_stream_fetch(u, t, buffer_id, ack=ack))
+                from ..data.types import parse_type
 
-        page = executor.execute(fragment, remote_pages)
+                types = [parse_type(t) for t in src["types"]]
+                remote_pages[fid] = wire_to_page(blobs, types)
 
-        out_kind = req["output_kind"]
-        out_parts = req["out_parts"]
-        if out_kind == "repartition":
-            from ..plan.serde import _decode
+            # dynamic filtering: fetched build-side key domains narrow the
+            # probe scans before upload (exec/dynfilter.py; reference:
+            # DynamicFilterService.java:103)
+            from ..exec.dynfilter import collect_dynamic_filters
 
-            keys = [_decode(k) for k in req["output_keys"]]
-            blobs = partition_page(page, keys, out_parts)
-            with self._lock:
-                for p, blob in enumerate(blobs):
-                    self.buffers[(task_id, p)] = blob
-        else:  # gather / broadcast / single / result
-            blob = page_to_wire(page)
-            with self._lock:
-                self.buffers[(task_id, 0)] = blob
-        self.task_state[task_id] = "FINISHED"
+            executor.scan_filters = collect_dynamic_filters(fragment, remote_pages)
+
+            page = executor.execute(fragment, remote_pages)
+
+            out_kind = req["output_kind"]
+            out_parts = req["out_parts"]
+            if out_kind == "repartition":
+                from ..plan.serde import _decode
+
+                keys = [_decode(k) for k in req["output_keys"]]
+                chunk_lists = partition_page(page, keys, out_parts)
+                task.finish({p: chunks for p, chunks in enumerate(chunk_lists)})
+            else:  # gather / broadcast / single / result
+                task.finish({0: page_to_wire_chunks(page)})
+        except Exception as e:
+            traceback.print_exc()
+            task.fail(str(e))
+
+    # -------------------------------------------------------- buffer access
+    def get_chunk(self, task_id: str, buffer_id: int, token: int, wait: float):
+        """-> (code, body, headers).  Long-polls until the chunk exists, the
+        buffer completes, or `wait` elapses."""
+        with self._lock:
+            task = self.tasks.get(task_id)
+        if task is None:
+            return 404, b"no such task", {}
+        deadline = wait
+        with task.cond:
+            while True:
+                if task.state == "FAILED":
+                    return 500, (task.error or "task failed").encode(), {}
+                chunks = task.buffers.get(buffer_id)
+                if chunks is not None and token < len(chunks):
+                    blob = chunks[token]
+                    if blob is None:
+                        return 410, b"chunk acknowledged and freed", {}
+                    last = task.complete and token == len(chunks) - 1
+                    return 200, blob, {"X-Complete": "1" if last else "0"}
+                if task.complete:
+                    # past the end: buffer exhausted
+                    return 200, b"", {"X-Complete": "1", "X-No-Data": "1"}
+                if deadline <= 0:
+                    return 200, b"", {"X-Complete": "0", "X-No-Data": "1"}
+                task.cond.wait(timeout=min(deadline, 1.0))
+                deadline -= 1.0
+
+    def acknowledge(self, task_id: str, buffer_id: int, token: int) -> None:
+        with self._lock:
+            task = self.tasks.get(task_id)
+        if task is None:
+            return
+        with task.cond:
+            chunks = task.buffers.get(buffer_id)
+            if chunks is not None:
+                for i in range(min(token, len(chunks))):
+                    chunks[i] = None
+
+    def task_status(self, task_id: str, wait: float) -> dict:
+        with self._lock:
+            task = self.tasks.get(task_id)
+        if task is None:
+            return {"state": "UNKNOWN"}
+        with task.cond:
+            if task.state == "RUNNING" and wait > 0:
+                task.cond.wait(timeout=wait)
+            return {"state": task.state, "error": task.error}
+
+    def delete_task(self, task_id: str) -> None:
+        with self._lock:
+            task = self.tasks.pop(task_id, None)
+        if task is not None:
+            task.canceled = True
+            with task.cond:
+                task.buffers = {}
 
 
-def _fetch(url: str) -> bytes:
-    with urllib.request.urlopen(url, timeout=60) as r:
-        return r.read()
+def _stream_fetch(
+    worker_url: str, task_id: str, buffer_id: int, ack: bool = True
+) -> list[bytes]:
+    """Token-sequenced consumption of one producer buffer with acknowledge —
+    the reference's HttpPageBufferClient loop (sendGetResults:355, token+ack
+    :406-424).  Retries make delivery at-least-once; exact token addressing
+    makes assembly exactly-once."""
+    blobs: list[bytes] = []
+    token = 0
+    attempts = 0
+    while True:
+        url = f"{worker_url}/v1/task/{task_id}/results/{buffer_id}/{token}?wait=30"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r:
+                body = r.read()
+                complete = r.headers.get("X-Complete") == "1"
+                no_data = r.headers.get("X-No-Data") == "1"
+        except urllib.error.HTTPError as e:
+            # 500 = producer task failed, 404/410 = buffer gone: permanent
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(
+                f"fetch {task_id}/{buffer_id}/{token} from {worker_url}: "
+                f"HTTP {e.code}: {detail}"
+            )
+        except Exception:
+            attempts += 1
+            if attempts > 5:
+                raise
+            continue
+        attempts = 0
+        if body and not no_data:
+            blobs.append(body)
+            token += 1
+            if ack:  # free everything below the next token on the producer
+                _quiet_get(
+                    f"{worker_url}/v1/task/{task_id}/results/{buffer_id}/{token}/acknowledge"
+                )
+            if complete:
+                return blobs
+        elif complete:
+            return blobs
+        # else: no data yet — long-poll again
+
+
+def _quiet_get(url: str) -> None:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            r.read()
+    except Exception:
+        pass
 
 
 def _make_handler(worker: Worker):
@@ -117,29 +291,42 @@ def _make_handler(worker: Worker):
         def log_message(self, *args):  # quiet
             pass
 
-        def _send(self, code: int, body: bytes, ctype="application/octet-stream"):
+        def _send(self, code: int, body: bytes, ctype="application/octet-stream", headers=None):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
-            parts = self.path.strip("/").split("/")
+            path, _, query = self.path.partition("?")
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv
+            )
+            parts = path.strip("/").split("/")
             if parts[:2] == ["v1", "info"]:
                 body = json.dumps(
-                    {"state": "active", "tasks": len(worker.task_state)}
+                    {"state": "active", "tasks": len(worker.tasks)}
                 ).encode()
                 return self._send(200, body, "application/json")
-            # /v1/task/{id}/results/{buffer}/{token}
+            # /v1/task/{id}/status
+            if len(parts) == 4 and parts[:2] == ["v1", "task"] and parts[3] == "status":
+                wait = float(params.get("wait", "0"))
+                st = worker.task_status(parts[2], wait)
+                return self._send(200, json.dumps(st).encode(), "application/json")
+            # /v1/task/{id}/results/{buffer}/{token}[/acknowledge]
             if len(parts) >= 5 and parts[:2] == ["v1", "task"] and parts[3] == "results":
                 task_id = parts[2]
                 buffer_id = int(parts[4])
-                with worker._lock:
-                    blob = worker.buffers.get((task_id, buffer_id))
-                if blob is None:
-                    return self._send(404, b"no such buffer")
-                return self._send(200, blob)
+                if len(parts) >= 7 and parts[6] == "acknowledge":
+                    worker.acknowledge(task_id, buffer_id, int(parts[5]))
+                    return self._send(200, b"{}", "application/json")
+                token = int(parts[5]) if len(parts) >= 6 else 0
+                wait = float(params.get("wait", "0"))
+                code, body, headers = worker.get_chunk(task_id, buffer_id, token, wait)
+                return self._send(code, body, headers=headers)
             return self._send(404, b"not found")
 
         def do_POST(self):
@@ -148,13 +335,8 @@ def _make_handler(worker: Worker):
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "task"]:
                 req = json.loads(body)
-                try:
-                    worker.run_task(req)
-                    return self._send(200, b'{"state": "FINISHED"}', "application/json")
-                except Exception as e:
-                    traceback.print_exc()
-                    msg = json.dumps({"state": "FAILED", "error": str(e)}).encode()
-                    return self._send(500, msg, "application/json")
+                worker.submit_task(req)
+                return self._send(200, b'{"state": "RUNNING"}', "application/json")
             if parts[:2] == ["v1", "inject_failure"]:
                 req = json.loads(body)
                 worker.injected_failures.add(req.get("task_id", "*"))
@@ -164,13 +346,9 @@ def _make_handler(worker: Worker):
         def do_DELETE(self):
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "task"]:
-                task_id = parts[2]
-                with worker._lock:
-                    worker.buffers = {
-                        k: v for k, v in worker.buffers.items() if k[0] != task_id
-                    }
-                    worker.task_state.pop(task_id, None)
+                worker.delete_task(parts[2])
                 return self._send(200, b"{}")
             return self._send(404, b"not found")
 
     return Handler
+

@@ -44,6 +44,18 @@ class FloatLit(Expr):
 
 
 @dataclass(frozen=True)
+class DecimalLit(Expr):
+    """Unquoted literal with a decimal point, e.g. 0.06 -> (6, 2).
+
+    Trino types these as DECIMAL(p, s), not DOUBLE — the distinction matters
+    on TPU, where DOUBLE comparisons are f32 and cannot honor boundaries
+    like `between 0.06 - 0.01 and 0.06 + 0.01` exactly."""
+
+    unscaled: int
+    scale: int
+
+
+@dataclass(frozen=True)
 class StrLit(Expr):
     value: str
 
@@ -184,6 +196,7 @@ class Relation:
 class Table(Relation):
     name: str
     alias: Optional[str] = None
+    catalog: Optional[str] = None  # first part of catalog[.schema].table
 
 
 @dataclass(frozen=True)

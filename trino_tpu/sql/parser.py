@@ -16,8 +16,8 @@ from __future__ import annotations
 from typing import Optional
 
 from .ast import (
-    Between, BinOp, BoolLit, CaseExpr, Cast, DateLit, Exists, Expr, Extract,
-    FloatLit, FuncCall, Ident, InList, InSubquery, IntLit, IntervalLit, IsNull,
+    Between, BinOp, BoolLit, CaseExpr, Cast, DateLit, DecimalLit, Exists, Expr,
+    Extract, FloatLit, FuncCall, Ident, InList, InSubquery, IntLit, IntervalLit, IsNull,
     JoinRelation, Like, Neg, Not, NullLit, Query, Relation, ScalarSubquery,
     Select, SelectItem, SortItem, Star, StrLit, SubqueryRelation, Table,
 )
@@ -266,12 +266,15 @@ class _Parser:
             rel = self.parse_join_chain()
             self.expect_op(")")
             return rel
-        name = self.ident()
-        # swallow catalog.schema qualifiers: keep the last part as table name
+        parts = [self.ident()]
         while self.accept_op("."):
-            name = self.ident()
+            parts.append(self.ident())
         alias = self._optional_alias()
-        return Table(name, alias)
+        # catalog[.schema].table: first part routes to a registered catalog,
+        # any middle schema part is accepted and ignored (single-schema
+        # catalogs; the reference resolves via MetadataManager)
+        catalog = parts[0] if len(parts) > 1 else None
+        return Table(parts[-1], alias, catalog)
 
     def _optional_alias(self) -> Optional[str]:
         if self.accept_kw("AS"):
@@ -378,7 +381,13 @@ class _Parser:
         t = self.cur
         if t.kind == "NUMBER":
             self.i += 1
-            if "." in t.value or "e" in t.value or "E" in t.value:
+            if "e" in t.value or "E" in t.value:
+                return FloatLit(float(t.value))
+            if "." in t.value:
+                whole, _, frac = t.value.partition(".")
+                digits = (whole + frac).lstrip("0") or "0"
+                if len(digits) <= 18:
+                    return DecimalLit(int(whole + frac or "0"), len(frac))
                 return FloatLit(float(t.value))
             return IntLit(int(t.value))
         if t.kind == "STRING":

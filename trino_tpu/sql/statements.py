@@ -200,7 +200,9 @@ def _parse_statement(p: "_Parser") -> Statement:
 
 
 def _table_name(p: "_Parser") -> str:
+    """Possibly qualified target: keeps the dotted form (catalog.table) so
+    the executor can resolve the catalog (Engine._target_conn)."""
     name = p.ident()
     while p.accept_op("."):
-        name = p.ident()
+        name = f"{name}.{p.ident()}"
     return name

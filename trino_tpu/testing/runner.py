@@ -22,10 +22,16 @@ __all__ = ["DistributedQueryRunner"]
 
 
 class DistributedQueryRunner:
-    def __init__(self, num_workers: int = 2, default_catalog: str = "tpch"):
+    def __init__(
+        self,
+        num_workers: int = 2,
+        default_catalog: str = "tpch",
+        heartbeat_interval: float = 2.0,
+    ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
         self.num_workers = num_workers
+        self.heartbeat_interval = heartbeat_interval
         self.coordinator: Optional[Coordinator] = None
         self.workers: list[Worker] = []
 
@@ -33,7 +39,11 @@ class DistributedQueryRunner:
         self.catalogs.register(name, connector)
 
     def start(self) -> "DistributedQueryRunner":
-        self.coordinator = Coordinator(self.catalogs, self.default_catalog).start()
+        self.coordinator = Coordinator(
+            self.catalogs,
+            self.default_catalog,
+            heartbeat_interval=self.heartbeat_interval,
+        ).start()
         for _ in range(self.num_workers):
             w = Worker(self.catalogs, self.default_catalog).start()
             self.workers.append(w)
