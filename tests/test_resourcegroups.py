@@ -1,0 +1,135 @@
+"""Resource governance: admission control, queueing, rejection, declared-
+memory admission, and query cancellation.
+
+Reference: execution/resourcegroups/InternalResourceGroup.java (hierarchy,
+hard concurrency, max queued), dispatcher/DispatchManager (queued phase),
+memory/ClusterMemoryManager.java:92 (pool admission), TaskResource DELETE
+(cancel)."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.runtime.resourcegroups import (
+    QueryRejected, ResourceGroupConfig, ResourceGroupManager,
+)
+
+
+def test_concurrency_and_fifo_queue():
+    mgr = ResourceGroupManager(ResourceGroupConfig(max_concurrency=1, max_queued=10))
+    started = []
+    mgr.submit("global", "q1", 0, lambda: started.append("q1"))
+    mgr.submit("global", "q2", 0, lambda: started.append("q2"))
+    mgr.submit("global", "q3", 0, lambda: started.append("q3"))
+    assert started == ["q1"]
+    mgr.finish("q1")
+    assert started == ["q1", "q2"]
+    mgr.finish("q2")
+    mgr.finish("q3")
+    assert started == ["q1", "q2", "q3"]
+
+
+def test_queue_full_rejects():
+    mgr = ResourceGroupManager(ResourceGroupConfig(max_concurrency=1, max_queued=1))
+    mgr.submit("global", "q1", 0, lambda: None)
+    mgr.submit("global", "q2", 0, lambda: None)
+    with pytest.raises(QueryRejected):
+        mgr.submit("global", "q3", 0, lambda: None)
+
+
+def test_hierarchy_parent_limit():
+    cfg = ResourceGroupConfig(
+        "global", max_concurrency=1,
+        subgroups=(
+            ResourceGroupConfig("a", max_concurrency=1),
+            ResourceGroupConfig("b", max_concurrency=1),
+        ),
+    )
+    mgr = ResourceGroupManager(cfg)
+    started = []
+    mgr.submit("a", "qa", 0, lambda: started.append("qa"))
+    # parent slot is taken: b's query queues even though b itself is free
+    mgr.submit("b", "qb", 0, lambda: started.append("qb"))
+    assert started == ["qa"]
+    mgr.finish("qa")
+    assert started == ["qa", "qb"]
+
+
+def test_memory_admission():
+    mgr = ResourceGroupManager(
+        ResourceGroupConfig(max_concurrency=10, memory_limit_bytes=100)
+    )
+    started = []
+    mgr.submit("global", "q1", 60, lambda: started.append("q1"))
+    mgr.submit("global", "q2", 60, lambda: started.append("q2"))  # over limit
+    assert started == ["q1"]
+    mgr.finish("q1")
+    assert started == ["q1", "q2"]
+
+
+def test_oversized_budget_rejected_not_wedged():
+    # a budget that can never fit must reject at submit, not queue forever
+    mgr = ResourceGroupManager(
+        ResourceGroupConfig(max_concurrency=10, memory_limit_bytes=100)
+    )
+    with pytest.raises(QueryRejected):
+        mgr.submit("global", "qbig", 200, lambda: None)
+    started = []
+    mgr.submit("global", "q1", 50, lambda: started.append("q1"))
+    assert started == ["q1"]  # group not wedged
+
+
+def test_cancel_queued_atomicity():
+    mgr = ResourceGroupManager(ResourceGroupConfig(max_concurrency=1))
+    mgr.submit("global", "q1", 0, lambda: None)
+    mgr.submit("global", "q2", 0, lambda: None)  # queued
+    assert mgr.cancel_queued("q2") is True
+    assert mgr.cancel_queued("q1") is False  # running: must not free the slot
+    assert mgr.stats()["global"]["running"] == 1
+
+
+def test_admission_and_cancel_via_coordinator(tpch_tiny):
+    from trino_tpu.client import StatementClient
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(num_workers=1)
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    try:
+        cli = StatementClient(runner.coordinator.url)
+        # cancel a queued query deterministically: occupy the only slot
+        runner.coordinator.resource_groups = __import__(
+            "trino_tpu.runtime.resourcegroups", fromlist=["ResourceGroupManager"]
+        ).ResourceGroupManager(ResourceGroupConfig(max_concurrency=1, max_queued=5))
+        gate = threading.Event()
+        release = threading.Event()
+
+        def hog():
+            runner.coordinator.resource_groups.submit(
+                "global", "hog", 0, lambda: gate.set()
+            )
+            release.wait(30)
+            runner.coordinator.resource_groups.finish("hog")
+
+        t = threading.Thread(target=hog, daemon=True)
+        t.start()
+        assert gate.wait(5)
+        qid = cli.submit("select count(*) from lineitem")
+        time.sleep(0.2)
+        assert cli.query_state(qid) == "QUEUED"
+        assert cli.cancel(qid)
+        deadline = time.monotonic() + 10
+        while cli.query_state(qid) != "FAILED" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert cli.query_state(qid) == "FAILED"
+        release.set()
+        t.join(10)
+        # the freed slot admits and completes a fresh query
+        cols, rows = cli.execute("select count(*) from region")
+        assert rows[0][0] == 5
+        info = cli.server_info()
+        assert "resource_groups" in info
+    finally:
+        runner.stop()

@@ -44,6 +44,29 @@ class StatementClient:
             with urllib.request.urlopen(next_uri, timeout=30) as r:
                 state = json.loads(r.read())
 
+    def submit(self, sql: str) -> str:
+        """Fire-and-return: the query id (poll or cancel it later)."""
+        req = urllib.request.Request(
+            f"{self.server_url}/v1/statement", data=sql.encode()
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())["id"]
+
+    def cancel(self, query_id: str) -> bool:
+        """Reference: StatementClient close() -> DELETE nextUri."""
+        req = urllib.request.Request(
+            f"{self.server_url}/v1/statement/{query_id}", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read()).get("canceled", False)
+
+    def query_state(self, query_id: str) -> str:
+        # state-only endpoint: polling never ships the result payload
+        with urllib.request.urlopen(
+            f"{self.server_url}/v1/query/{query_id}/state", timeout=10
+        ) as r:
+            return json.loads(r.read()).get("state", "UNKNOWN")
+
     def server_info(self) -> dict:
         with urllib.request.urlopen(f"{self.server_url}/v1/info", timeout=10) as r:
             return json.loads(r.read())

@@ -294,6 +294,8 @@ class LocalExecutor:
                 caps[nid] = _pow2(max(max(child_sizes), 1))
                 if n.kind == "left":
                     return caps[nid] + child_sizes[0]
+                if n.kind == "full":
+                    return caps[nid] + child_sizes[0] + child_sizes[1]
                 return caps[nid]
             if isinstance(n, TopN):
                 # radix-select candidate buffer (ops/relops.py top_n): room

@@ -99,6 +99,16 @@ def eval_predicate(e: IrExpr, cols: Sequence[ColumnVal], n: int) -> jnp.ndarray:
 
 def _const_val(e: Const, n: int) -> ColumnVal:
     if e.value is None:
+        if e.type.is_string:
+            # typed NULL varchar (e.g. GROUPING SETS null-extends a key):
+            # 1-entry dictionary keeps the string machinery uniform
+            d = Dictionary(np.asarray([""], dtype=object))
+            return ColumnVal(
+                jnp.zeros((n,), dtype=jnp.int32),
+                jnp.zeros((n,), dtype=jnp.bool_),
+                d,
+                e.type,
+            )
         dt = jnp.bool_ if e.type == BOOLEAN else _np_to_jnp(e.type)
         return ColumnVal(
             jnp.zeros((n,), dtype=dt), jnp.zeros((n,), dtype=jnp.bool_), None, e.type
@@ -224,8 +234,10 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         return ColumnVal(jnp.abs(args[0].data), valid, None, e.type)
     if op == "round":
         if len(args) == 2:
-            digits = int(args[1].data[0]) if hasattr(args[1].data, "__getitem__") else 0
-            f = 10.0 **digits
+            # digit count is a plan-time literal, never a traced lane
+            assert isinstance(e.args[1], Const), "round() digits must be a literal"
+            digits = int(e.args[1].value)
+            f = 10.0 ** digits
             return ColumnVal(jnp.round(args[0].data * f) / f, valid, None, e.type)
         return ColumnVal(jnp.round(args[0].data), valid, None, e.type)
     if op == "floor":
@@ -239,7 +251,261 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         return ColumnVal(
             jnp.power(a.astype(jnp.float64), b.astype(jnp.float64)), valid, None, e.type
         )
+
+    # ---- float math (f64 lanes on the VPU) --------------------------------
+    _F64_UNARY = {
+        "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "exp": jnp.exp,
+        "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+        "acos": jnp.arccos, "atan": jnp.arctan, "cbrt": jnp.cbrt,
+        "degrees": jnp.degrees, "radians": jnp.radians,
+    }
+    if op in _F64_UNARY:
+        x = args[0].data.astype(jnp.float64)
+        out = _F64_UNARY[op](x)
+        # domain errors are NULL, not NaN (SQL semantics)
+        dom = ~jnp.isnan(out)
+        return ColumnVal(out, _and_valid(valid, dom), None, e.type)
+    if op == "atan2":
+        a, b = _numeric_align(args[0].data, args[1].data)
+        return ColumnVal(
+            jnp.arctan2(a.astype(jnp.float64), b.astype(jnp.float64)),
+            valid, None, e.type,
+        )
+    if op == "sign":
+        return ColumnVal(jnp.sign(args[0].data), valid, None, e.type)
+    if op == "truncate":
+        x = args[0].data.astype(jnp.float64)
+        if len(e.args) == 2:
+            assert isinstance(e.args[1], Const), "truncate() scale must be a literal"
+            f = 10.0 ** int(e.args[1].value)
+            return ColumnVal(jnp.trunc(x * f) / f, valid, None, e.type)
+        return ColumnVal(jnp.trunc(x), valid, None, e.type)
+    if op in ("bitwise_and", "bitwise_or", "bitwise_xor", "shift_left", "shift_right"):
+        a = args[0].data.astype(jnp.int64)
+        b = args[1].data.astype(jnp.int64)
+        out = {
+            "bitwise_and": lambda: a & b,
+            "bitwise_or": lambda: a | b,
+            "bitwise_xor": lambda: a ^ b,
+            "shift_left": lambda: a << b,
+            "shift_right": lambda: a >> b,
+        }[op]()
+        return ColumnVal(out, valid, None, e.type)
+
+    # ---- conditional ------------------------------------------------------
+    if op == "nullif":
+        a, b = args
+        if a.dict is not None or b.dict is not None:
+            eqv = _string_compare("eq", [a, b], e, n)
+            eq_mask = eqv.data.astype(jnp.bool_)
+        else:
+            x, y = _numeric_align(a.data, b.data)
+            eq_mask = x == y
+        bv = jnp.ones((n,), jnp.bool_) if b.valid is None else b.valid
+        both = eq_mask & bv  # NULLIF only nulls when b is non-null and equal
+        av = jnp.ones((n,), jnp.bool_) if a.valid is None else a.valid
+        return ColumnVal(a.data, av & ~both, a.dict, a.type)
+    if op in ("greatest", "least"):
+        fn = jnp.maximum if op == "greatest" else jnp.minimum
+        acc = args[0].data.astype(_np_to_jnp(e.type))
+        for v in args[1:]:
+            acc = fn(acc, v.data.astype(_np_to_jnp(e.type)))
+        return ColumnVal(acc, valid, None, e.type)  # NULL if any arg NULL
+
+    # ---- date -------------------------------------------------------------
+    if op in ("extract_quarter", "extract_dow", "extract_doy", "extract_week"):
+        a = args[0]
+        days = a.data.astype(jnp.int64)
+        y, m, d = _civil_from_days(days)
+        if op == "extract_quarter":
+            out = (m + 2) // 3
+        elif op == "extract_dow":
+            # ISO day-of-week 1..7 (Mon=1); epoch 1970-01-01 was a Thursday
+            out = (days + 3) % 7 + 1
+        else:
+            jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+            doy = days - jan1 + 1
+            if op == "extract_doy":
+                out = doy
+            else:  # ISO week number (approximation: week of Jan-4 anchor)
+                jan4 = jan1 + 3
+                wk_anchor = jan4 - ((jan4 + 3) % 7)
+                out = jnp.maximum((days - wk_anchor) // 7 + 1, 1)
+        return ColumnVal(out, a.valid, None, e.type)
+    if op == "date_trunc":
+        # unit is compile-time constant (args[1] folded by the planner)
+        unit = e.args[1].value  # type: ignore[union-attr]
+        a = args[0]
+        days = a.data.astype(jnp.int64)
+        y, m, d = _civil_from_days(days)
+        one = jnp.ones_like(m)
+        if unit == "year":
+            out = _days_from_civil(y, one, one)
+        elif unit == "quarter":
+            out = _days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+        elif unit == "month":
+            out = _days_from_civil(y, m, one)
+        elif unit == "week":  # ISO week start (Monday)
+            out = days - (days + 3) % 7
+        elif unit == "day":
+            out = days
+        else:
+            raise NotImplementedError(f"date_trunc unit {unit}")
+        return ColumnVal(out.astype(a.data.dtype), a.valid, None, DATE)
+    if op == "last_day_of_month":
+        a = args[0]
+        days = a.data.astype(jnp.int64)
+        y, m, _ = _civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        out = _days_from_civil(ny, nm, jnp.ones_like(nm)) - 1
+        return ColumnVal(out.astype(a.data.dtype), a.valid, None, DATE)
+    if op == "date_diff_days":
+        a, b = args
+        out = b.data.astype(jnp.int64) - a.data.astype(jnp.int64)
+        return ColumnVal(out, valid, None, e.type)
+
+    # ---- strings (host maps over the dictionary, device gathers) ----------
+    if op in _STR_UNARY:
+        return _dict_map_str(args[0], _STR_UNARY[op], e.type)
+    if op in ("replace", "strpos", "starts_with", "lpad", "rpad", "split_part",
+              "regexp_like", "regexp_replace", "regexp_extract", "concat_str"):
+        return _string_fn(op, e, args, n)
     raise NotImplementedError(f"call op: {op}")
+
+
+_STR_UNARY = {
+    "upper": str.upper,
+    "lower": str.lower,
+    "trim": str.strip,
+    "ltrim": str.lstrip,
+    "rtrim": str.rstrip,
+    "reverse_str": lambda s: s[::-1],
+}
+
+
+def _dict_map_str_nullable(a: ColumnVal, fn, out_type) -> ColumnVal:
+    """Like _dict_map_str but fn may return None (SQL NULL — e.g. a regex
+    that does not match).  NULL-producing codes merge their validity into
+    the column's mask."""
+    raw = [fn(str(v)) for v in a.dict.values]
+    ok = np.asarray([r is not None for r in raw], dtype=bool)
+    vals = np.asarray([r if r is not None else "" for r in raw], dtype=object)
+    uniq, remap = np.unique(vals, return_inverse=True)
+    codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+    ok_lane = jnp.take(jnp.asarray(ok), a.data)
+    return ColumnVal(
+        codes, _and_valid(a.valid, ok_lane), Dictionary(uniq), out_type
+    )
+
+
+def _dict_map_str(a: ColumnVal, fn, out_type) -> ColumnVal:
+    """str -> str function applied once per distinct dictionary VALUE; the
+    page's rows just gather the remapped codes (the reference's
+    DictionaryAwarePageProjection does the same per-distinct-value trick)."""
+    vals = [fn(str(v)) for v in a.dict.values]
+    uniq, remap = np.unique(np.asarray(vals, dtype=object), return_inverse=True)
+    codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+    return ColumnVal(codes, a.valid, Dictionary(uniq), out_type)
+
+
+def _const_str(e_arg) -> str:
+    assert isinstance(e_arg, Const), "argument must be a literal"
+    return str(e_arg.value)
+
+
+def _string_fn(op: str, e: Call, args: list[ColumnVal], n: int) -> ColumnVal:
+    """String functions with extra (literal) arguments.  All evaluate on the
+    dictionary host-side; scalar results gather through a host table."""
+    import re as _re
+
+    a = args[0]
+
+    def str_out(fn) -> ColumnVal:
+        return _dict_map_str(a, fn, e.type)
+
+    def scalar_out(table: np.ndarray, dtype) -> ColumnVal:
+        t = jnp.asarray(table.astype(dtype))
+        return ColumnVal(jnp.take(t, a.data), a.valid, None, e.type)
+
+    if op == "replace":
+        old, new = _const_str(e.args[1]), _const_str(e.args[2])
+        return str_out(lambda s: s.replace(old, new))
+    if op == "strpos":
+        needle = _const_str(e.args[1])
+        return scalar_out(
+            np.asarray([str(v).find(needle) + 1 for v in a.dict.values]), np.int64
+        )
+    if op == "starts_with":
+        prefix = _const_str(e.args[1])
+        return scalar_out(
+            np.asarray([str(v).startswith(prefix) for v in a.dict.values]), np.bool_
+        )
+    if op in ("lpad", "rpad"):
+        width = int(e.args[1].value)  # type: ignore[union-attr]
+        pad = _const_str(e.args[2]) if len(e.args) > 2 else " "
+
+        def _pad(s: str) -> str:
+            if len(s) >= width:
+                return s[:width]
+            fill = (pad * width)[: width - len(s)]
+            return fill + s if op == "lpad" else s + fill
+
+        return str_out(_pad)
+    if op == "split_part":
+        delim, ix = _const_str(e.args[1]), int(e.args[2].value)  # type: ignore[union-attr]
+
+        def _split(s: str):
+            parts = s.split(delim)
+            # out-of-range index is NULL (Trino semantics), not ''
+            return parts[ix - 1] if 1 <= ix <= len(parts) else None
+
+        return _dict_map_str_nullable(a, _split, e.type)
+    if op == "regexp_like":
+        pat = _re.compile(_const_str(e.args[1]))
+        return scalar_out(
+            np.asarray([bool(pat.search(str(v))) for v in a.dict.values]), np.bool_
+        )
+    if op == "regexp_replace":
+        pat = _re.compile(_const_str(e.args[1]))
+        repl = _const_str(e.args[2]) if len(e.args) > 2 else ""
+        return str_out(lambda s: pat.sub(repl, s))
+    if op == "regexp_extract":
+        pat = _re.compile(_const_str(e.args[1]))
+        group = int(e.args[2].value) if len(e.args) > 2 else 0  # type: ignore[union-attr]
+
+        def _ext(s: str):
+            # no match / non-participating group is NULL (Trino semantics)
+            m = pat.search(s)
+            return m.group(group) if m else None
+
+        return _dict_map_str_nullable(a, _ext, e.type)
+    if op == "concat_str":
+        # n-ary concat over dict-coded and literal operands.  Pairwise dict x
+        # dict combine is bounded by |A| * |B| distinct outputs — fine for
+        # the low-cardinality dictionaries string columns encode to.
+        out = args[0]
+        for nxt_ir, nxt in zip(e.args[1:], args[1:]):
+            if isinstance(nxt_ir, Const):
+                lit = str(nxt_ir.value)
+                out = _dict_map_str(out, lambda s, _l=lit: s + _l, e.type)
+                continue
+            if len(out.dict) * len(nxt.dict) > 1_000_000:
+                raise NotImplementedError(
+                    "concat of two high-cardinality string columns"
+                )
+            pair_vals = np.asarray(
+                [str(x) + str(y) for x in out.dict.values for y in nxt.dict.values],
+                dtype=object,
+            )
+            uniq, remap = np.unique(pair_vals, return_inverse=True)
+            pair_code = out.data * len(nxt.dict) + nxt.data
+            codes = jnp.take(jnp.asarray(remap.astype(np.int32)), pair_code)
+            out = ColumnVal(
+                codes, _and_valid(out.valid, nxt.valid), Dictionary(uniq), e.type
+            )
+        return out
+    raise NotImplementedError(f"string op {op}")
 
 
 def _numeric_align(a: jnp.ndarray, b: jnp.ndarray):
@@ -474,3 +740,14 @@ def _civil_from_days(z: jnp.ndarray):
     m = mp + jnp.where(mp < 10, 3, -9)
     y = y + (m <= 2)
     return y, m, d
+
+
+def _days_from_civil(y: jnp.ndarray, m: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """(year, month, day) -> days-since-epoch; exact inverse of
+    _civil_from_days (same public-domain algorithm)."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    doy = jnp.floor_divide(153 * (m + jnp.where(m > 2, -3, 9)) + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468

@@ -563,19 +563,33 @@ def equi_join(
     if kind == "inner":
         return gathered, match, required
 
-    if kind == "left":
+    if kind in ("left", "full"):
         # expansion lanes ++ unmatched left lanes with null right columns
+        # (full: ++ unmatched RIGHT lanes with null left columns too)
         hit = jnp.zeros((nl,), jnp.bool_).at[pidx_c].max(match, mode="drop")
         unmatched = left_live & ~hit
+        full = kind == "full"
+        if full:
+            bhit = jnp.zeros((nr,), jnp.bool_).at[bidx].max(match, mode="drop")
+            unmatched_r = right_live & ~bhit
         out: list[ColumnVal] = []
         for i, cv in enumerate(left_cols):
-            tail_valid = None if cv.valid is None else cv.valid
             data = jnp.concatenate([gathered[i].data, cv.data])
             valid = (
                 None
-                if cv.valid is None
-                else jnp.concatenate([gathered[i].valid, cv.valid])
+                if cv.valid is None and not full
+                else jnp.concatenate(
+                    [
+                        gathered[i].valid
+                        if gathered[i].valid is not None
+                        else jnp.ones((C,), jnp.bool_),
+                        cv.valid if cv.valid is not None else jnp.ones((nl,), jnp.bool_),
+                    ]
+                )
             )
+            if full:
+                data = jnp.concatenate([data, jnp.zeros((nr,), cv.data.dtype)])
+                valid = jnp.concatenate([valid, jnp.zeros((nr,), jnp.bool_)])
             out.append(ColumnVal(data, valid, cv.dict, cv.type))
         off = len(left_cols)
         for i, cv in enumerate(right_cols):
@@ -583,8 +597,18 @@ def equi_join(
             gv = g.valid if g.valid is not None else jnp.ones((C,), jnp.bool_)
             data = jnp.concatenate([g.data, jnp.zeros((nl,), cv.data.dtype)])
             valid = jnp.concatenate([gv, jnp.zeros((nl,), jnp.bool_)])
+            if full:
+                data = jnp.concatenate([data, cv.data])
+                valid = jnp.concatenate(
+                    [
+                        valid,
+                        cv.valid if cv.valid is not None else jnp.ones((nr,), jnp.bool_),
+                    ]
+                )
             out.append(ColumnVal(data, valid, cv.dict, cv.type))
         out_live = jnp.concatenate([match, unmatched])
+        if full:
+            out_live = jnp.concatenate([out_live, unmatched_r])
         return out, out_live, required
 
     raise NotImplementedError(f"join kind {kind}")

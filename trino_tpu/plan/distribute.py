@@ -329,6 +329,17 @@ class _Distributor:
             # give partition-local answers.
             or node.kind == "null_anti"
         )
+        if node.kind == "full":
+            # a replicated build would emit its unmatched rows once PER
+            # DEVICE; full outer must co-partition both sides (the reference
+            # makes the same restriction in DetermineJoinDistributionType)
+            broadcast = False
+            if lpart.kind == "replicated":
+                left = Exchange(left, "single")
+                lpart = _Part("any")
+            if rpart.kind == "replicated":
+                right = Exchange(right, "single")
+                rpart = _Part("any")
 
         if broadcast:
             if rpart.kind != "replicated":

@@ -253,15 +253,31 @@ class Planner:
             names.append(it.alias or _derive_name(it.expr, len(names)))
         out_fields = [Field(None, n, e.type) for n, e in zip(names, exprs)]
 
-        # ORDER BY may reference select aliases, positions, or input columns
-        # that also appear as select expressions (TPC-H needs no hidden sort
-        # columns beyond these).
+        # ORDER BY may reference select aliases, positions, or arbitrary
+        # expressions over the input scope; the latter become HIDDEN sort
+        # columns dropped by a final projection (the reference's
+        # QueryPlanner does the same via a synthesized Symbol).
         sort_keys: list[SortKey] = []
+        hidden: list[IrExpr] = []
         for si in order_by:
-            k = self._resolve_order_key(si, items, exprs, names, translator)
+            try:
+                k = self._resolve_order_key(si, items, exprs, names, translator)
+            except PlanningError:
+                if sel.distinct:
+                    raise PlanningError(
+                        "for SELECT DISTINCT, ORDER BY expressions must "
+                        "appear in the select list"
+                    )
+                t_ir = translator.translate(si.expr)
+                k = FieldRef(len(exprs) + len(hidden), t_ir.type)
+                hidden.append(t_ir)
             sort_keys.append(SortKey(k, si.ascending, _nulls_first(si)))
 
-        proj = Project(rel.node, tuple(exprs), tuple(names))
+        proj = Project(
+            rel.node,
+            tuple(exprs) + tuple(hidden),
+            tuple(names) + tuple(f"_s{i}" for i in range(len(hidden))),
+        )
         node: PlanNode = proj
         if sel.distinct:
             node = Distinct(node)
@@ -273,6 +289,12 @@ class Planner:
                 node = Sort(node, tuple(sort_keys))
         elif limit is not None:
             node = Limit(node, limit)
+        if hidden:
+            node = Project(
+                node,
+                tuple(FieldRef(i, e.type) for i, e in enumerate(exprs)),
+                tuple(names),
+            )
         return RelationPlan(node, out_fields)
 
     def _resolve_order_key(
@@ -501,8 +523,6 @@ class Planner:
                 return self._make_join("inner", left, right, [], outer)
             if r.kind == "right":
                 return self._swap_right_join(left, right, r.on, outer)
-            if r.kind == "full":
-                raise PlanningError("FULL OUTER JOIN not supported yet")
             conjuncts: list[A.Expr] = []
             rel = self._make_join(r.kind, left, right, conjuncts, outer, extra_on=r.on)
             for c in conjuncts:  # ON leftovers that didn't classify
@@ -559,20 +579,11 @@ class Planner:
         outer: Optional[Scope],
         ctes: dict[str, A.Query],
     ) -> tuple[RelationPlan, dict[A.Expr, FieldRef]]:
+        if sel.grouping_sets is not None:
+            return self._plan_grouping_sets(rel, sel, agg_calls, outer)
         t = _Translator(rel.scope, outer)
         group_irs = [t.translate(g) for g in sel.group_by]
-        aggs: list[AggCall] = []
-        for fc in agg_calls:
-            if fc.name == "count" and not fc.args:
-                aggs.append(AggCall("count_star", None, BIGINT))
-                continue
-            arg = t.translate(fc.args[0])
-            if fc.name == "avg" and arg.type.is_decimal:
-                # avg over decimals divides at the end in f64; feeding the
-                # accumulator doubles keeps relops scale-agnostic
-                arg = _cast_ir(arg, DOUBLE)
-            out_t = _agg_type(fc.name, arg.type)
-            aggs.append(AggCall(fc.name, arg, out_t, fc.distinct))
+        aggs = self._build_agg_calls(agg_calls, t)
         names = tuple(f"_g{i}" for i in range(len(group_irs))) + tuple(
             f"_a{i}" for i in range(len(aggs))
         )
@@ -596,6 +607,137 @@ class Planner:
         base = len(group_irs)
         for i, fc in enumerate(agg_calls):
             agg_map[fc] = FieldRef(base + i, aggs[i].type)
+        return RelationPlan(node, fields), agg_map
+
+    def _build_agg_calls(self, agg_calls: list[A.FuncCall], t: "_Translator") -> list[AggCall]:
+        aggs: list[AggCall] = []
+        for fc in agg_calls:
+            if fc.name == "count" and not fc.args:
+                aggs.append(AggCall("count_star", None, BIGINT))
+                continue
+            arg = t.translate(fc.args[0])
+            if fc.name == "avg" and arg.type.is_decimal:
+                # avg over decimals divides at the end in f64; feeding the
+                # accumulator doubles keeps relops scale-agnostic
+                arg = _cast_ir(arg, DOUBLE)
+            out_t = _agg_type(fc.name, arg.type)
+            aggs.append(AggCall(fc.name, arg, out_t, fc.distinct))
+        return aggs
+
+    def _plan_grouping_sets(
+        self,
+        rel: RelationPlan,
+        sel: A.Select,
+        agg_calls: list[A.FuncCall],
+        outer: Optional[Scope],
+    ) -> tuple[RelationPlan, dict[A.Expr, FieldRef]]:
+        """GROUPING SETS / ROLLUP / CUBE (reference: GroupIdNode feeding a
+        single AggregationNode, sql/planner/QueryPlanner planGroupingSets).
+
+        Lowering: per set, project [key exprs (NULL where the key is absent
+        from the set), every child column, set-id literal]; Concat the
+        copies; aggregate once on (keys..., gid).  The gid keeps a data NULL
+        in a key distinct from a rollup NULL, so e.g. ROLLUP totals never
+        merge with a NULL-keyed data group."""
+        from ..plan.ir import remap
+        from .nodes import Concat
+
+        t = _Translator(rel.scope, outer)
+        key_irs = [t.translate(g) for g in sel.group_by]
+        aggs = self._build_agg_calls(agg_calls, t)
+        K = len(key_irs)
+        n_child = len(rel.fields)
+        child_types = [f.type for f in rel.fields]
+
+        copies = []
+        for sid, s in enumerate(sel.grouping_sets):
+            exprs = [
+                (key_irs[i] if i in s else Const(None, key_irs[i].type))
+                for i in range(K)
+            ]
+            exprs += [FieldRef(j, child_types[j]) for j in range(n_child)]
+            exprs.append(Const(sid, BIGINT))
+            names = tuple(
+                [f"_k{i}" for i in range(K)]
+                + [f"_c{j}" for j in range(n_child)]
+                + ["_gid"]
+            )
+            copies.append(Project(rel.node, tuple(exprs), names))
+        concat = Concat(tuple(copies))
+
+        # aggregate over the expanded frame: keys are precomputed columns,
+        # agg args shift past the K key columns
+        shift = {j: K + j for j in range(n_child)}
+        group_irs = [FieldRef(i, key_irs[i].type) for i in range(K)] + [
+            FieldRef(K + n_child, BIGINT)
+        ]
+        shifted = [
+            AggCall(a.fn, None if a.arg is None else remap(a.arg, shift), a.type, a.distinct)
+            for a in aggs
+        ]
+        names = tuple(f"_g{i}" for i in range(K + 1)) + tuple(
+            f"_a{i}" for i in range(len(shifted))
+        )
+        node = Aggregate(concat, tuple(group_irs), tuple(shifted), names)
+
+        fields: list[Field] = []
+        for g_ast, g_ir in zip(sel.group_by, key_irs):
+            if isinstance(g_ast, A.Ident):
+                hit = rel.scope.try_resolve(g_ast.parts)
+                f = rel.fields[hit[1]]
+                fields.append(Field(f.qualifier, f.name, g_ir.type))
+            else:
+                fields.append(Field(None, None, g_ir.type))
+        fields.append(Field(None, None, BIGINT))  # hidden gid
+        for a in shifted:
+            fields.append(Field(None, None, a.type))
+
+        agg_map: dict[A.Expr, FieldRef] = {}
+        for i, g_ast in enumerate(sel.group_by):
+            agg_map[g_ast] = FieldRef(i, key_irs[i].type)
+        base = K + 1
+        for i, fc in enumerate(agg_calls):
+            agg_map[fc] = FieldRef(base + i, shifted[i].type)
+
+        # GROUPING(e...) -> bitmask constant per set, selected by gid
+        # (reference: GroupingOperationRewriter): bit b (MSB = first arg) is
+        # 1 when the arg is NOT grouped in the row's set
+        def _walk(e):
+            yield e
+            for c in _ast_children(e):
+                yield from _walk(c)
+
+        scan = [it.expr for it in sel.items if isinstance(it, A.SelectItem)]
+        if sel.having is not None:
+            scan.append(sel.having)
+        gid_ref = FieldRef(K, BIGINT)
+        for e in scan:
+            for x in _walk(e):
+                if (
+                    isinstance(x, A.FuncCall)
+                    and x.name == "grouping"
+                    and x not in agg_map
+                ):
+                    positions = []
+                    for a in x.args:
+                        if a not in sel.group_by:
+                            raise PlanningError(
+                                "grouping() arguments must be grouping keys"
+                            )
+                        positions.append(sel.group_by.index(a))
+                    whens = []
+                    for sid, s in enumerate(sel.grouping_sets):
+                        mask = 0
+                        for b, pos in enumerate(positions):
+                            if pos not in s:
+                                mask |= 1 << (len(positions) - 1 - b)
+                        whens.append(
+                            (
+                                Call("eq", (gid_ref, Const(sid, BIGINT)), BOOLEAN),
+                                Const(mask, BIGINT),
+                            )
+                        )
+                    agg_map[x] = CaseWhen(tuple(whens), Const(0, BIGINT), BIGINT)
         return RelationPlan(node, fields), agg_map
 
     # --------------------------------------------------------------- windows
@@ -1151,12 +1293,118 @@ class _Translator:
                 _cast_ir(a, DOUBLE) if a.type.is_decimal else a for a in args
             )
             return Call("power", args, DOUBLE)
-        if name == "year":
-            return Call("extract_year", args, BIGINT)
+        if name in ("year", "month", "day", "quarter", "week",
+                    "day_of_week", "dow", "day_of_year", "doy"):
+            op = {
+                "year": "extract_year", "month": "extract_month",
+                "day": "extract_day", "quarter": "extract_quarter",
+                "week": "extract_week", "day_of_week": "extract_dow",
+                "dow": "extract_dow", "day_of_year": "extract_doy",
+                "doy": "extract_doy",
+            }[name]
+            return Call(op, args, BIGINT)
         if name == "length":
             if args[0].type != VARCHAR:
                 raise PlanningError("length requires varchar")
             return Call("length", args, BIGINT)
+
+        # ---- float math ---------------------------------------------------
+        if name in ("ln", "log2", "log10", "exp", "sin", "cos", "tan", "asin",
+                    "acos", "atan", "cbrt", "degrees", "radians", "truncate"):
+            args = tuple(
+                _cast_ir(a, DOUBLE) if a.type.is_decimal else a for a in args
+            )
+            if (
+                name == "truncate"
+                and len(args) == 1
+                and isinstance(args[0], Const)
+                and args[0].value is not None
+            ):
+                import math as _math
+
+                return Const(float(_math.trunc(args[0].value)), DOUBLE)
+            return Call(name, args, DOUBLE)
+        if name == "atan2":
+            return Call("atan2", args, DOUBLE)
+        if name == "mod":
+            out_t = common_super_type(args[0].type, args[1].type)
+            return Call("mod", tuple(_cast_ir(a, out_t) for a in args), out_t)
+        if name == "sign":
+            if args[0].type.is_floating:
+                return Call("sign", args, DOUBLE)
+            # decimal lanes carry scaled ints: the raw sign is already right
+            return Call("sign", args, BIGINT)
+        if name == "pi":
+            import math as _math
+
+            return Const(_math.pi, DOUBLE)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_left_shift", "bitwise_right_shift"):
+            op = {
+                "bitwise_and": "bitwise_and", "bitwise_or": "bitwise_or",
+                "bitwise_xor": "bitwise_xor",
+                "bitwise_left_shift": "shift_left",
+                "bitwise_right_shift": "shift_right",
+            }[name]
+            return Call(op, args, BIGINT)
+
+        # ---- conditional --------------------------------------------------
+        if name == "nullif":
+            return Call("nullif", args, args[0].type)
+        if name == "if":
+            whens = ((_as_bool(args[0]), args[1]),)
+            default = args[2] if len(args) > 2 else Const(None, args[1].type)
+            return CaseWhen(whens, default, args[1].type)
+        if name in ("greatest", "least"):
+            out_t = args[0].type
+            for a in args[1:]:
+                out_t = common_super_type(out_t, a.type)
+            return Call(name, tuple(_cast_ir(a, out_t) for a in args), out_t)
+
+        # ---- date ---------------------------------------------------------
+        if name == "date_trunc":
+            # ('unit', date) in Trino argument order
+            unit, d = args[0], args[1]
+            assert isinstance(unit, Const), "date_trunc unit must be a literal"
+            return Call("date_trunc", (d, unit), DATE)
+        if name == "date_diff":
+            unit, a, b = args
+            assert isinstance(unit, Const) and unit.value == "day", (
+                "date_diff supports 'day'"
+            )
+            return Call("date_diff_days", (a, b), BIGINT)
+        if name == "last_day_of_month":
+            return Call("last_day_of_month", args, DATE)
+
+        # ---- strings ------------------------------------------------------
+        if name in ("upper", "lower", "trim", "ltrim", "rtrim"):
+            return Call(name, args, VARCHAR)
+        if name == "reverse":
+            return Call("reverse_str", args, VARCHAR)
+        if name in ("replace", "lpad", "rpad", "split_part", "regexp_replace",
+                    "regexp_extract"):
+            return Call(name, args, VARCHAR)
+        if name == "concat":
+            coerced = []
+            for a in args:
+                if a.type == VARCHAR:
+                    coerced.append(a)
+                elif isinstance(a, Const) and a.value is not None:
+                    coerced.append(Const(str(a.value), VARCHAR))
+                else:
+                    # dictionary-coded lanes can't synthesize strings from
+                    # traced numeric data on device
+                    raise PlanningError(
+                        "|| / concat requires varchar operands "
+                        f"(got {a.type.name}); cast on the client side"
+                    )
+            return Call("concat_str", tuple(coerced), VARCHAR)
+        if name == "strpos" or name == "position":
+            return Call("strpos", args, BIGINT)
+        if name == "starts_with":
+            return Call("starts_with", args, BOOLEAN)
+        if name == "regexp_like":
+            return Call("regexp_like", args, BOOLEAN)
         raise PlanningError(f"unknown function: {name}")
 
 

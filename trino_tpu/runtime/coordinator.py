@@ -59,13 +59,17 @@ class Coordinator:
         default_catalog: str = "tpch",
         port: int = 0,
         heartbeat_interval: float = 2.0,
+        resource_groups=None,
     ):
+        from .resourcegroups import ResourceGroupManager
+
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.planner = Planner(catalogs, default_catalog)
         self.session = SessionProperties()
         self.workers: dict[str, _WorkerInfo] = {}
         self.queries: dict[str, dict] = {}
+        self.resource_groups = ResourceGroupManager(resource_groups)
         self._lock = threading.Lock()
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
@@ -117,9 +121,61 @@ class Coordinator:
     # ------------------------------------------------------------ execution
     def execute_query(self, sql: str) -> list[tuple]:
         """Synchronous execution (the HTTP protocol wraps this async)."""
+        qid = self.submit_query(sql)
+        record = self.queries[qid]
+        sm: QueryStateMachine = record["sm"]
+        record["done"].wait()
+        if sm.state == "FAILED":
+            raise RuntimeError(sm.error)
+        return record["result"]
+
+    def submit_query(self, sql: str) -> str:
+        """Admission-controlled submit (reference: DispatchManager.createQuery
+        queueing through resource groups before SqlQueryExecution starts).
+        The query's declared memory budget counts against its group while it
+        runs; a full queue rejects immediately."""
+        from .resourcegroups import QueryRejected
+
         qid = f"q_{uuid.uuid4().hex[:12]}"
         sm = QueryStateMachine(qid)
-        record = {"sm": sm, "sql": sql, "result": None, "columns": None}
+        record = {
+            "sm": sm, "sql": sql, "result": None, "columns": None,
+            "done": threading.Event(),
+        }
+        with self._lock:
+            self.queries[qid] = record
+
+        def start():
+            threading.Thread(
+                target=self._run_admitted, args=(record,), daemon=True
+            ).start()
+
+        group = self.session.get("resource_group")
+        mem = int(self.session.get("query_max_memory_bytes") or 0)
+        try:
+            self.resource_groups.submit(group, qid, mem, start)
+        except QueryRejected as e:
+            sm.fail(str(e))
+            record["done"].set()
+        return qid
+
+    def _run_admitted(self, record: dict) -> None:
+        try:
+            self._run(record)
+        finally:
+            self.resource_groups.finish(record["sm"].query_id)
+            record["done"].set()
+
+    def _execute_query_unmanaged(self, sql) -> list[tuple]:
+        """Run a query without resource-group admission — for SELECTs nested
+        inside an already-admitted statement (CTAS / INSERT...SELECT), which
+        would deadlock against their own group's concurrency slot."""
+        qid = f"q_{uuid.uuid4().hex[:12]}"
+        sm = QueryStateMachine(qid)
+        record = {
+            "sm": sm, "sql": sql, "result": None, "columns": None,
+            "done": threading.Event(),
+        }
         with self._lock:
             self.queries[qid] = record
         self._run(record)
@@ -127,14 +183,23 @@ class Coordinator:
             raise RuntimeError(sm.error)
         return record["result"]
 
-    def submit_query(self, sql: str) -> str:
-        qid = f"q_{uuid.uuid4().hex[:12]}"
-        sm = QueryStateMachine(qid)
-        record = {"sm": sm, "sql": sql, "result": None, "columns": None}
+    def cancel_query(self, qid: str) -> bool:
+        """Cancel a queued or running query (reference: DELETE
+        /v1/statement/{id} -> DispatchManager.cancelQuery).  Running stages
+        observe the flag between scheduling steps; already-posted tasks are
+        deleted by the run's cleanup path."""
         with self._lock:
-            self.queries[qid] = record
-        threading.Thread(target=self._run, args=(record,), daemon=True).start()
-        return qid
+            record = self.queries.get(qid)
+        if record is None:
+            return False
+        record["cancel"] = True
+        sm: QueryStateMachine = record["sm"]
+        # atomic with admission: True only while the query is still in the
+        # group queue, so a concurrent start can never lose its slot
+        if self.resource_groups.cancel_queued(qid):
+            sm.fail("Query was canceled")
+            record["done"].set()
+        return True
 
     def _run(self, record: dict) -> None:
         sm: QueryStateMachine = record["sm"]
@@ -152,6 +217,8 @@ class Coordinator:
                 try:
                     sm.transition("PLANNING")
                     sm.transition("RUNNING")
+                    if record.get("cancel"):
+                        raise RuntimeError("Query was canceled")
                     rows = _statement_surface(self).execute_stmt(stmt)
                     record["result"] = rows
                     record["columns"] = (
@@ -260,6 +327,8 @@ class Coordinator:
         sm.transition("RUNNING")
         try:
             for f in sorted(fragments, key=lambda f: -f.id):
+                if record.get("cancel"):
+                    raise RuntimeError("Query was canceled")
                 if f.output_kind == "result":
                     continue  # runs on coordinator below
                 out_parts = ntasks[consumer_of[f.id]]
@@ -517,7 +586,8 @@ def _statement_surface(coord: "Coordinator"):
             return optimize(self.planner.plan(sql_or_query))
 
         def query(self, sql_or_query) -> list[tuple]:
-            return self._coord.execute_query(sql_or_query)
+            # unmanaged: the enclosing statement already holds the group slot
+            return self._coord._execute_query_unmanaged(sql_or_query)
 
         def _query_columns(self, query):
             plan = self.plan(query)
@@ -590,6 +660,13 @@ def _make_handler(coord: Coordinator):
                 return self._send_json(200, {})
             return self._send_json(404, {"error": "not found"})
 
+        def do_DELETE(self):
+            parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
+                ok = coord.cancel_query(parts[2])
+                return self._send_json(200 if ok else 404, {"canceled": ok})
+            return self._send_json(404, {"error": "not found"})
+
         def do_GET(self):
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "info"]:
@@ -601,7 +678,17 @@ def _make_handler(coord: Coordinator):
                             for w in coord.workers.values()
                         ],
                         "queries": len(coord.queries),
+                        "resource_groups": coord.resource_groups.stats(),
                     },
+                )
+            if parts[:2] == ["v1", "query"] and len(parts) >= 4 and parts[3] == "state":
+                # cheap state probe: never serializes result rows
+                with coord._lock:
+                    record = coord.queries.get(parts[2])
+                if record is None:
+                    return self._send_json(404, {"error": "unknown query"})
+                return self._send_json(
+                    200, {"id": parts[2], "state": record["sm"].state}
                 )
             if parts[:2] == ["v1", "statement"] and len(parts) >= 4:
                 qid = parts[2]

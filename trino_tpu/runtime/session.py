@@ -62,6 +62,12 @@ PROPERTIES: dict[str, _Prop] = {
         ),
         _Prop("explain_format", str, "text", "text | json", None),
         _Prop(
+            "resource_group", str, "global",
+            "resource group this session's queries are admitted through "
+            "(reference: resource-group selectors on user/source)",
+            None,
+        ),
+        _Prop(
             "query_max_memory_bytes", int, 0,
             "device-memory budget per query; 0 = unlimited.  Queries whose "
             "estimated working set exceeds it run out-of-core: partitioned "

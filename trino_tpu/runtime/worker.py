@@ -96,6 +96,18 @@ class Worker:
         self.url = f"http://127.0.0.1:{self.port}"
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
+    def buffered_bytes(self) -> int:
+        """Un-acknowledged output bytes parked on this worker (the number the
+        reference's OutputBufferMemoryManager bounds)."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        total = 0
+        for t in tasks:
+            with t.cond:
+                for chunks in t.buffers.values():
+                    total += sum(len(c) for c in chunks if c is not None)
+        return total
+
     def start(self) -> "Worker":
         self._thread.start()
         return self
@@ -307,8 +319,19 @@ def _make_handler(worker: Worker):
             )
             parts = path.strip("/").split("/")
             if parts[:2] == ["v1", "info"]:
+                import resource as _res
+
                 body = json.dumps(
-                    {"state": "active", "tasks": len(worker.tasks)}
+                    {
+                        "state": "active",
+                        "tasks": len(worker.tasks),
+                        # cluster memory visibility (reference: MemoryInfo
+                        # polled by ClusterMemoryManager.java:92); ru_maxrss
+                        # is KiB on linux
+                        "rss_bytes": _res.getrusage(_res.RUSAGE_SELF).ru_maxrss
+                        * 1024,
+                        "buffered_bytes": worker.buffered_bytes(),
+                    }
                 ).encode()
                 return self._send(200, body, "application/json")
             # /v1/task/{id}/status

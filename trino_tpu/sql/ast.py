@@ -237,6 +237,10 @@ class Select:
     group_by: tuple[Expr, ...] = ()
     having: Optional[Expr] = None
     distinct: bool = False
+    # GROUPING SETS / ROLLUP / CUBE, pre-expanded by the parser: each set is
+    # a tuple of indices into group_by (the distinct key expressions).
+    # None == plain GROUP BY over all of group_by.
+    grouping_sets: Optional[tuple[tuple[int, ...], ...]] = None
 
 
 @dataclass(frozen=True)

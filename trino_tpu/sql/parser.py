@@ -208,16 +208,90 @@ class _Parser:
         if self.accept_kw("WHERE"):
             where = self.parse_expr()
         group_by: list[Expr] = []
+        grouping_sets = None
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            while True:
-                group_by.append(self.parse_expr())
-                if not self.accept_op(","):
-                    break
+            group_by, grouping_sets = self._parse_group_by()
         having = None
         if self.accept_kw("HAVING"):
             having = self.parse_expr()
-        return Select(tuple(items), tuple(relations), where, tuple(group_by), having, distinct)
+        return Select(
+            tuple(items), tuple(relations), where, tuple(group_by), having,
+            distinct, grouping_sets,
+        )
+
+    def _parse_group_by(self):
+        """GROUP BY items: plain exprs mixed with ROLLUP / CUBE / GROUPING
+        SETS.  Expands to (distinct key exprs, sets of key indices) — the
+        cross-product combination Trino's analyzer performs
+        (sql/analyzer/StatementAnalyzer GroupingSetAnalysis).  Returns
+        grouping_sets=None for a plain GROUP BY."""
+        keys: list[Expr] = []
+
+        def key_ix(e: Expr) -> int:
+            for i, k in enumerate(keys):
+                if k == e:
+                    return i
+            keys.append(e)
+            return len(keys) - 1
+
+        def parse_paren_exprs() -> list[Expr]:
+            self.expect_op("(")
+            out = []
+            if not self.accept_op(")"):
+                while True:
+                    out.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return out
+
+        item_sets: list[list[tuple[int, ...]]] = []
+        plain_only = True
+        while True:
+            if self.accept_kw("ROLLUP"):
+                plain_only = False
+                ix = [key_ix(e) for e in parse_paren_exprs()]
+                item_sets.append([tuple(ix[:k]) for k in range(len(ix), -1, -1)])
+            elif self.accept_kw("CUBE"):
+                plain_only = False
+                ix = [key_ix(e) for e in parse_paren_exprs()]
+                sets = []
+                for mask in range(1 << len(ix)):
+                    sets.append(tuple(i for b, i in enumerate(ix) if mask >> b & 1))
+                item_sets.append(sorted(sets, key=len, reverse=True))
+            elif self.peek_kw("GROUPING") and self.peek_kw("SETS", offset=1):
+                self.accept_kw("GROUPING")
+                self.accept_kw("SETS")
+                plain_only = False
+                self.expect_op("(")
+                sets = []
+                while True:
+                    if self.peek_op("("):
+                        sets.append(tuple(key_ix(e) for e in parse_paren_exprs()))
+                    else:
+                        sets.append((key_ix(self.parse_expr()),))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                item_sets.append(sets)
+            else:
+                item_sets.append([(key_ix(self.parse_expr()),)])
+            if not self.accept_op(","):
+                break
+        if plain_only:
+            return keys, None
+        # cross-product combine the per-item set lists (GROUP BY a, ROLLUP(b))
+        combined: list[tuple[int, ...]] = [()]
+        for sets in item_sets:
+            combined = [c + s for c in combined for s in sets]
+        # dedupe while keeping order (CUBE(a) , CUBE(a) etc.)
+        seen, final = set(), []
+        for s in combined:
+            if s not in seen:
+                seen.add(s)
+                final.append(s)
+        return keys, tuple(final)
 
     def _is_reserved(self) -> bool:
         return self.cur.kind == "IDENT" and self.cur.upper() in _RESERVED_STOP
@@ -305,16 +379,16 @@ class _Parser:
         return self.parse_comparison()
 
     def parse_comparison(self) -> Expr:
-        left = self.parse_additive()
+        left = self.parse_concat()
         while True:
             negated = False
             save = self.i
             if self.accept_kw("NOT"):
                 negated = True
             if self.accept_kw("BETWEEN"):
-                low = self.parse_additive()
+                low = self.parse_concat()
                 self.expect_kw("AND")
-                high = self.parse_additive()
+                high = self.parse_concat()
                 left = Between(left, low, high, negated)
                 continue
             if self.accept_kw("IN"):
@@ -331,7 +405,7 @@ class _Parser:
                     left = InList(left, tuple(items), negated)
                 continue
             if self.accept_kw("LIKE"):
-                pattern = self.parse_additive()
+                pattern = self.parse_concat()
                 left = Like(left, pattern, negated)
                 continue
             if negated:
@@ -347,7 +421,16 @@ class _Parser:
                 return left
             if op == "!=":
                 op = "<>"
-            left = BinOp(op, left, self.parse_additive())
+            left = BinOp(op, left, self.parse_concat())
+
+    def parse_concat(self) -> Expr:
+        # `a || b` string concatenation, lowered to concat(a, b).  CONCAT is
+        # the loosest value-expression level (below +/-), per SqlBase.g4.
+        left = self.parse_additive()
+        while True:
+            if self.accept_op("||") is None:
+                return left
+            left = FuncCall("concat", (left, self.parse_additive()))
 
     def parse_additive(self) -> Expr:
         left = self.parse_multiplicative()
