@@ -1,0 +1,115 @@
+"""Extended aggregate library: approx_distinct, approx_percentile, the
+stddev/variance family, bool_and/bool_or, count_if, arbitrary
+(reference: operator/aggregation/ — 224 accumulator files; here a small
+orthogonal kernel core plus planner rewrites, ops/relops.py _fused_aggs)."""
+
+import math
+
+import pytest
+
+
+@pytest.fixture()
+def engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="memory")
+    eng.register_catalog("memory", MemoryConnector())
+    eng.execute("create table t (g varchar, x double, b boolean)")
+    eng.execute(
+        "insert into t values ('a', 1.0, true), ('a', 2.0, true), "
+        "('a', 3.0, false), ('b', 10.0, true), ('b', 10.0, true), "
+        "('b', null, true)"
+    )
+    return eng
+
+
+def test_approx_distinct(engine):
+    assert engine.execute("select approx_distinct(x) from t") == [(4,)]
+    assert engine.execute(
+        "select g, approx_distinct(x) from t group by g order by g"
+    ) == [("a", 3), ("b", 1)]
+
+
+def test_stddev_variance_grouped(engine):
+    rows = engine.execute(
+        "select g, stddev(x), var_samp(x), stddev_pop(x), var_pop(x) "
+        "from t group by g order by g"
+    )
+    g, sd, vs, sp, vp = rows[0]
+    assert g == "a"
+    assert abs(sd - 1.0) < 1e-9 and abs(vs - 1.0) < 1e-9
+    assert abs(vp - 2.0 / 3.0) < 1e-9 and abs(sp - math.sqrt(2.0 / 3.0)) < 1e-9
+    g, sd, vs, sp, vp = rows[1]
+    assert g == "b" and sd == 0.0 and vp == 0.0
+
+
+def test_stddev_single_value_is_null(engine):
+    # sample stddev of one value: NULL (n-1 == 0)
+    engine.execute("create table one (x double)")
+    engine.execute("insert into one values (5.0)")
+    assert engine.execute("select stddev(x), stddev_pop(x) from one") == [(None, 0.0)]
+
+
+def test_bool_and_or(engine):
+    assert engine.execute(
+        "select g, bool_and(b), bool_or(b), every(b) from t group by g order by g"
+    ) == [("a", False, True, False), ("b", True, True, True)]
+
+
+def test_count_if(engine):
+    assert engine.execute("select count_if(b) from t") == [(5,)]
+    assert engine.execute("select count_if(x > 2.5) from t") == [(3,)]
+
+
+def test_approx_percentile_global(engine):
+    # values 1,2,3,10,10 -> median 3
+    assert engine.execute("select approx_percentile(x, 0.5) from t") == [(3.0,)]
+    assert engine.execute("select approx_percentile(x, 0.0) from t") == [(1.0,)]
+    assert engine.execute("select approx_percentile(x, 1.0) from t") == [(10.0,)]
+
+
+def test_approx_percentile_grouped(engine):
+    assert engine.execute(
+        "select g, approx_percentile(x, 0.5) from t group by g order by g"
+    ) == [("a", 2.0), ("b", 10.0)]
+
+
+def test_approx_percentile_ignores_nulls(engine):
+    # group b has a NULL x: percentile over {10, 10}
+    assert engine.execute(
+        "select approx_percentile(x, 0.99) from t where g = 'b'"
+    ) == [(10.0,)]
+
+
+def test_arbitrary(engine):
+    assert engine.execute("select arbitrary(g) from t where g = 'b'") == [("b",)]
+    assert engine.execute("select any_value(x) from t where g = 'a'") == [(1.0,)]
+
+
+def test_distributed_new_aggs():
+    import jax
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    eng = Engine(default_catalog="memory", distributed=True)
+    eng.register_catalog("memory", MemoryConnector())
+    eng.execute("create table t (g bigint, x double)")
+    eng.execute(
+        "insert into t values (1, 1.0), (1, 2.0), (1, 3.0), (2, 10.0), "
+        "(2, 20.0), (1, 4.0), (2, 30.0), (1, 5.0)"
+    )
+    rows = eng.execute(
+        "select g, stddev_pop(x), approx_percentile(x, 0.5), approx_distinct(x) "
+        "from t group by g order by g"
+    )
+    g, sp, med, ad = rows[0]
+    assert g == 1 and abs(sp - math.sqrt(2.0)) < 1e-9 and med == 3.0 and ad == 5
+    g, sp, med, ad = rows[1]
+    assert g == 2 and med == 20.0 and ad == 3
+    # keyless raw-only aggregate gathers then aggregates once
+    # (nearest-rank: sorted [1,2,3,4,5,10,20,30], index round(0.5*7) == 4)
+    assert eng.execute("select approx_percentile(x, 0.5) from t") == [(5.0,)]

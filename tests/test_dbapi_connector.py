@@ -1,0 +1,106 @@
+"""DB-API connector framework (reference: plugin/trino-base-jdbc + derived
+plugins): schema discovery through the driver, projection/row-range
+pushdown, write path, joins against native catalogs."""
+
+import pytest
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    from trino_tpu.connectors.dbapi import SqliteConnector
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    db = str(tmp_path / "ext.db")
+    import sqlite3
+
+    conn = sqlite3.connect(db)
+    conn.execute("create table ext (k integer, v real, s text)")
+    conn.executemany(
+        "insert into ext values (?, ?, ?)",
+        [(1, 1.5, "a"), (2, 2.5, "b"), (3, None, "c"), (4, 4.5, None)],
+    )
+    conn.commit()
+    conn.close()
+
+    eng = Engine(default_catalog="sqlite")
+    eng.register_catalog("sqlite", SqliteConnector(db, splits_per_table=2))
+    eng.register_catalog("memory", MemoryConnector())
+    return eng
+
+
+def test_schema_discovery(engine):
+    assert engine.execute("show tables") == [("ext",)]
+    assert engine.execute("describe ext") == [
+        ("k", "bigint"), ("v", "double"), ("s", "varchar"),
+    ]
+
+
+def test_scan_with_nulls(engine):
+    assert engine.execute("select k, v, s from ext order by k") == [
+        (1, 1.5, "a"), (2, 2.5, "b"), (3, None, "c"), (4, 4.5, None),
+    ]
+
+
+def test_aggregate_over_dbapi(engine):
+    assert engine.execute("select count(*), sum(v) from ext") == [(4, 8.5)]
+
+
+def test_join_with_memory_catalog(engine):
+    engine.execute("create table memory.dim (k bigint, name varchar)")
+    engine.execute("insert into memory.dim values (1, 'one'), (3, 'three')")
+    rows = engine.execute(
+        "select e.k, d.name from ext e join memory.dim d on e.k = d.k order by e.k"
+    )
+    assert rows == [(1, "one"), (3, "three")]
+
+
+def test_write_path(engine):
+    engine.execute("create table out_t (k bigint, s varchar)")
+    engine.execute("insert into out_t values (10, 'x'), (20, null)")
+    assert engine.execute("select k, s from out_t order by k") == [
+        (10, "x"), (20, None),
+    ]
+    # verify it actually landed in sqlite
+    import sqlite3
+
+    db = engine.catalogs.get("sqlite").database
+    raw = sqlite3.connect(db).execute("select k, s from out_t order by k").fetchall()
+    assert raw == [(10, "x"), (20, None)]
+
+
+def test_dml_through_engine(engine):
+    engine.execute("create table d (k bigint)")
+    engine.execute("insert into d values (1), (2), (3)")
+    # DELETE needs truncate support; DbApiConnector has none -> rewrite path
+    # is unavailable, but sqlite-side data is still queryable
+    assert engine.execute("select count(*) from d") == [(3,)]
+
+
+def test_distributed_scan_splits(engine):
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from trino_tpu.connectors.dbapi import SqliteConnector
+    from trino_tpu.runtime.engine import Engine
+
+    db = engine.catalogs.get("sqlite").database
+    eng = Engine(default_catalog="sqlite", distributed=True)
+    eng.register_catalog("sqlite", SqliteConnector(db, splits_per_table=2))
+    assert eng.execute("select count(*), sum(k) from ext") == [(4, 10)]
+
+
+def test_decimal_scaling(engine, tmp_path):
+    import sqlite3
+
+    from trino_tpu.connectors.dbapi import SqliteConnector
+
+    db = str(tmp_path / "dec.db")
+    c = sqlite3.connect(db)
+    c.execute("create table m (price decimal(10,2))")
+    c.execute("insert into m values (12.34)")
+    c.commit()
+    c.close()
+    engine.register_catalog("sq", SqliteConnector(db))
+    assert engine.execute("select price from sq.m") == [(12.34,)]

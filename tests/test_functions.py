@@ -144,3 +144,55 @@ def test_functions_in_where_and_group(engine, oracle):
         " where length(o_orderpriority) > 5 group by upper(o_orderstatus)"
     )
     assert_rows_equal(engine.query(sql), oracle.query(sql), ordered=False)
+
+
+def test_json_functions(engine):
+    # JSON over varchar lanes: parse once per distinct value host-side
+    # (reference: operator/scalar/JsonFunctions)
+    rows = engine.query(
+        "select json_extract_scalar(j, '$.a'), json_extract_scalar(j, '$.b[1]'),"
+        " json_extract(j, '$.b'), json_array_length(j),"
+        " json_array_length(json_extract(j, '$.b')), json_size(j, '$')"
+        " from (select '{\"a\": \"x\", \"b\": [10, 20, 30]}' as j from nation limit 1)"
+    )
+    assert rows[0] == ("x", "20", "[10,20,30]", None, 3, 2)
+
+
+def test_json_malformed_is_null(engine):
+    rows = engine.query(
+        "select json_extract_scalar(j, '$.a') from"
+        " (select 'not json' as j from nation limit 1)"
+    )
+    assert rows[0] == (None,)
+
+
+def test_try_cast(engine):
+    rows = engine.query(
+        "select try_cast(s as bigint), try_cast(s as double),"
+        " try_cast(s as date) from"
+        " (select 'abc' as s from nation limit 1)"
+    )
+    assert rows[0] == (None, None, None)
+    rows = engine.query(
+        "select try_cast(s as bigint) from (select '42' as s from nation limit 1)"
+    )
+    assert rows[0] == (42,)
+    rows = engine.query("select try_cast('2024-01-15' as date)")
+    assert rows[0] == ("2024-01-15",)
+
+
+def test_try_cast_column(engine, tpch_tiny):
+    # mixed parseable/unparseable values in one dictionary
+    rows = engine.query(
+        "select try_cast(substring(n_name, 1, 1) as bigint) from nation limit 3"
+    )
+    assert all(r[0] is None for r in rows)  # letters never parse
+
+
+def test_json_path_strictness(engine):
+    # unsupported JSONPath syntax is an error, not a silent prefix match
+    with pytest.raises(Exception, match="JSON path"):
+        engine.query(
+            "select json_extract(j, '$.b[*]') from"
+            " (select '{}' as j from nation limit 1)"
+        )

@@ -58,3 +58,37 @@ def test_event_listener_failure_isolated(engine):
         assert rows[0][0] > 0
     finally:
         engine.events._listeners.clear()
+
+
+def test_tracing_spans(engine):
+    """Query execution emits a query span with planner/execute children
+    (reference: OpenTelemetry spans, SqlQueryExecution.java:473)."""
+    from trino_tpu.utils.tracing import InMemorySpanExporter
+
+    exp = InMemorySpanExporter()
+    engine.tracer.add_exporter(exp)
+    try:
+        engine.query("select count(*) from region")
+        root = exp.traces[-1]
+        assert root.name == "query"
+        assert root.attributes.get("rows") == 1
+        assert root.find("planner") is not None
+        assert root.find("execute") is not None
+        assert root.duration_ms >= root.find("planner").duration_ms
+        d = root.to_dict()
+        assert d["name"] == "query" and len(d["children"]) == 2
+    finally:
+        engine.tracer._exporters.clear()
+
+
+def test_tracing_error_recorded(engine):
+    from trino_tpu.utils.tracing import InMemorySpanExporter
+
+    exp = InMemorySpanExporter()
+    engine.tracer.add_exporter(exp)
+    try:
+        with pytest.raises(Exception):
+            engine.query("select * from no_such_table")
+        assert "error" in exp.traces[-1].attributes
+    finally:
+        engine.tracer._exporters.clear()

@@ -1,0 +1,62 @@
+"""ORC connector: stripe splits, null round-trips, write path
+(reference: lib/trino-orc OrcReader/OrcRecordReader)."""
+
+import pytest
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    from trino_tpu.connectors.orc import OrcConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="orc")
+    eng.register_catalog("orc", OrcConnector(str(tmp_path)))
+    return eng
+
+
+def test_roundtrip_with_nulls(engine):
+    engine.execute("create table t (k bigint, v double, s varchar)")
+    engine.execute("insert into t values (1, 1.5, 'a'), (2, 2.5, null), (3, null, 'c')")
+    engine.execute("insert into t values (4, 4.5, 'd')")  # second file
+    assert engine.execute("select k, v, s from t order by k") == [
+        (1, 1.5, "a"), (2, 2.5, None), (3, None, "c"), (4, 4.5, "d"),
+    ]
+    assert engine.execute("select count(*), count(v), sum(v) from t") == [(4, 3, 8.5)]
+
+
+def test_ctas_orc(engine):
+    engine.execute("create table src (k bigint)")
+    engine.execute("insert into src values (1), (2), (3)")
+    engine.execute("create table dst as select k * 2 as k2 from src where k > 1")
+    assert engine.execute("select k2 from dst order by k2") == [(4,), (6,)]
+
+
+def test_matches_parquet_connector(engine, tmp_path):
+    """Same rows through ORC and Parquet produce identical results."""
+    from trino_tpu.connectors.parquet import ParquetConnector
+
+    engine.register_catalog("parquet", ParquetConnector(str(tmp_path / "pq")))
+    for cat in ("orc", "parquet"):
+        engine.execute(f"create table {cat}.data (k bigint, s varchar)")
+        engine.execute(f"insert into {cat}.data values (1, 'x'), (2, 'y'), (3, 'x')")
+    a = engine.execute("select s, count(*) from orc.data group by s order by s")
+    b = engine.execute("select s, count(*) from parquet.data group by s order by s")
+    assert a == b == [("x", 2), ("y", 1)]
+
+
+def test_stripe_splits_distributed(engine):
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from trino_tpu.connectors.orc import OrcConnector
+    from trino_tpu.runtime.engine import Engine
+
+    root = engine.catalogs.get("orc").root
+    engine.execute("create table big (k bigint)")
+    engine.execute(
+        "insert into big values " + ", ".join(f"({i})" for i in range(100))
+    )
+    eng = Engine(default_catalog="orc", distributed=True)
+    eng.register_catalog("orc", OrcConnector(root))
+    assert eng.execute("select count(*), sum(k) from big") == [(100, 4950)]

@@ -1,0 +1,82 @@
+"""Access control (reference: security/AccessControlManager +
+plugin/trino-file-based-access-control): SELECT checked per plan scan,
+writes checked at statement dispatch, session properties gated."""
+
+import pytest
+
+from trino_tpu.runtime.security import (
+    AccessDeniedError, AllowAllAccessControl, FileBasedAccessControl,
+)
+
+
+@pytest.fixture()
+def engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="memory")
+    eng.register_catalog("memory", MemoryConnector())
+    eng.execute("create table open_t (k bigint)")
+    eng.execute("insert into open_t values (1)")
+    eng.execute("create table secret_t (k bigint)")
+    eng.execute("insert into secret_t values (99)")
+    return eng
+
+
+RULES = {
+    "tables": [
+        {"user": "admin", "catalog": "*", "table": "*", "privileges": ["OWNERSHIP"]},
+        {"user": "*", "catalog": "memory", "table": "open_t", "privileges": ["SELECT"]},
+    ],
+    "session_properties": [
+        {"user": "admin", "property": "*", "allow": True},
+        {"user": "*", "property": "join_distribution_type", "allow": True},
+    ],
+}
+
+
+def test_allow_all_default(engine):
+    assert isinstance(engine.access_control, AllowAllAccessControl)
+    assert engine.execute("select k from secret_t") == [(99,)]
+
+
+def test_select_denied(engine):
+    engine.access_control = FileBasedAccessControl(RULES)
+    engine.user = "bob"
+    assert engine.execute("select k from open_t") == [(1,)]
+    with pytest.raises(AccessDeniedError):
+        engine.execute("select k from secret_t")
+    # denial applies through subqueries/joins too (check is per plan scan)
+    with pytest.raises(AccessDeniedError):
+        engine.execute("select * from open_t where k in (select k from secret_t)")
+
+
+def test_write_denied(engine):
+    engine.access_control = FileBasedAccessControl(RULES)
+    engine.user = "bob"
+    with pytest.raises(AccessDeniedError):
+        engine.execute("insert into open_t values (2)")
+    with pytest.raises(AccessDeniedError):
+        engine.execute("delete from open_t")
+    with pytest.raises(AccessDeniedError):
+        engine.execute("drop table open_t")
+    with pytest.raises(AccessDeniedError):
+        engine.execute("create table new_t (x bigint)")
+
+
+def test_admin_ownership(engine):
+    engine.access_control = FileBasedAccessControl(RULES)
+    engine.user = "admin"
+    engine.execute("insert into secret_t values (100)")
+    assert engine.execute("select count(*) from secret_t") == [(2,)]
+    engine.execute("drop table secret_t")
+
+
+def test_session_property_rules(engine):
+    engine.access_control = FileBasedAccessControl(RULES)
+    engine.user = "bob"
+    engine.execute("set session join_distribution_type = 'BROADCAST'")
+    with pytest.raises(AccessDeniedError):
+        engine.execute("set session broadcast_join_row_limit = 10")
+    engine.user = "admin"
+    engine.execute("set session broadcast_join_row_limit = 10")

@@ -64,6 +64,37 @@ WINDOW_QUERIES = {
                                   rows unbounded preceding) as rm
         from orders where o_custkey < 40
     """,
+    "offset_frame_sum": """
+        select o_custkey, o_orderkey,
+          sum(o_totalprice) over (partition by o_custkey order by o_orderkey
+                                  rows between 2 preceding and 1 following) as s,
+          count(*) over (partition by o_custkey order by o_orderkey
+                         rows between 1 preceding and 1 following) as c
+        from orders where o_custkey < 40
+    """,
+    "offset_frame_minmax": """
+        select o_custkey, o_orderkey,
+          min(o_totalprice) over (partition by o_custkey order by o_orderkey
+                                  rows between 2 preceding and current row) as mn,
+          max(o_totalprice) over (partition by o_custkey order by o_orderkey
+                                  rows between current row and unbounded following) as mx
+        from orders where o_custkey < 40
+    """,
+    "ntile_ranks": """
+        select o_custkey, o_orderkey,
+          ntile(3) over (partition by o_custkey order by o_orderkey) as nt,
+          percent_rank() over (partition by o_custkey order by o_orderkey) as pr,
+          cume_dist() over (partition by o_custkey order by o_orderkey) as cd
+        from orders where o_custkey < 40
+    """,
+    "nth_value": """
+        select o_custkey, o_orderkey,
+          nth_value(o_orderkey, 2) over (partition by o_custkey
+                                         order by o_orderkey
+                                         rows between unbounded preceding
+                                         and unbounded following) as nv
+        from orders where o_custkey < 40
+    """,
 }
 
 

@@ -46,6 +46,30 @@ class MemoryConnector(Connector):
         self._data.pop(name)
         self.generation += 1
 
+    def truncate(self, name: str) -> None:
+        """Drop all rows, keep the schema (DML rewrite-and-swap write path)."""
+        schema = self.table_schema(name)
+        self._data[name] = {
+            c.name: np.empty((0,), dtype=object if c.type.is_string else c.type.np_dtype)
+            for c in schema.columns
+        }
+        self.generation += 1
+
+    # ---- transactions (reference: connector transaction handles) -----------
+    def snapshot(self):
+        """Copy-on-write state capture: writes replace whole column arrays
+        (insert/truncate build new arrays), so shallow dict copies suffice."""
+        return (
+            dict(self._tables),
+            {t: dict(cols) for t, cols in self._data.items()},
+        )
+
+    def restore(self, snap) -> None:
+        self._tables, self._data = dict(snap[0]), {
+            t: dict(cols) for t, cols in snap[1].items()
+        }
+        self.generation += 1
+
     # ---- reads -------------------------------------------------------------
     def get_splits(self, table: str, desired_parts: int) -> list[Split]:
         return [Split("memory", table, p, desired_parts) for p in range(desired_parts)]

@@ -103,6 +103,18 @@ class Dictionary:
         uniq, codes = np.unique(arr, return_inverse=True)
         return codes.astype(np.int32), Dictionary(uniq)
 
+    @staticmethod
+    def encode_arrays(values: Sequence) -> tuple[np.ndarray, "Dictionary"]:
+        """Encode a column of arrays (lists/tuples) as codes into a dictionary
+        of distinct tuples (ARRAY columns use the same codes+dict lowering as
+        VARCHAR — data/types.py ArrayType).  Built element-by-element: a plain
+        np.asarray over equal-length tuples would produce a 2-D array."""
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = tuple(v) if isinstance(v, (list, tuple, np.ndarray)) else ()
+        uniq, codes = np.unique(arr, return_inverse=True)
+        return codes.astype(np.int32), Dictionary(uniq)
+
     def __repr__(self) -> str:
         return f"Dictionary({len(self.values)} values)"
 
@@ -140,6 +152,9 @@ class Column:
             if mask.any():
                 ok = ~mask
                 valid = ok if valid is None else (np.asarray(valid) & ok)
+        if type_.is_array:
+            codes, dictionary = Dictionary.encode_arrays(values)
+            return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
         if type_.is_string:
             codes, dictionary = Dictionary.encode(values)
             return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
@@ -202,7 +217,17 @@ class Page:
         for col in self.columns:
             data = np.asarray(col.data)[idx]
             valid = None if col.valid is None else np.asarray(col.valid)[idx]
-            if col.type.is_string:
+            if col.type.is_array:
+                vals = (
+                    col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
+                    if len(idx)
+                    else np.array([], dtype=object)
+                )
+                out_arr = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    out_arr[i] = list(v)
+                pys.append(out_arr)
+            elif col.type.is_string:
                 vals = col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))] if len(idx) else np.array([], dtype=object)
                 pys.append(vals)
             elif col.type == DATE:
@@ -241,7 +266,14 @@ class Page:
         out: list[np.ndarray] = []
         for col in self.columns:
             data = np.asarray(col.data)[idx]
-            if col.type.is_string:
+            if col.type.is_array:
+                if len(idx):
+                    data = col.dictionary.values[
+                        np.clip(data, 0, max(len(col.dictionary) - 1, 0))
+                    ]
+                else:
+                    data = np.array([], dtype=object)
+            elif col.type.is_string:
                 if len(idx):
                     data = col.dictionary.values[
                         np.clip(data, 0, max(len(col.dictionary) - 1, 0))

@@ -29,6 +29,7 @@ __all__ = [
     "VARCHAR",
     "TIMESTAMP",
     "DecimalType",
+    "ArrayType",
     "UNKNOWN",
     "date_to_days",
     "days_to_date",
@@ -63,6 +64,10 @@ class Type:
     @property
     def is_decimal(self) -> bool:
         return self.name.startswith("decimal")
+
+    @property
+    def is_array(self) -> bool:
+        return False
 
     @property
     def is_orderable(self) -> bool:
@@ -110,6 +115,29 @@ class DecimalType(Type):
         object.__setattr__(self, "scale", scale)
 
 
+@dataclass(frozen=True, repr=False)
+class ArrayType(Type):
+    """ARRAY(T), dictionary-encoded like VARCHAR: the device column is int32
+    codes into a host-side table of distinct arrays (tuples).  This is the
+    TPU lowering of the reference's ArrayBlock (spi/block/ArrayBlock.java:
+    offsets + flattened element block): no varlen data in HBM, and per-
+    distinct-value host evaluation makes array functions cheap.  Runtime-
+    *constructed* arrays (array_agg) are future work — arrays flow from
+    literals, connector columns, split(), and sequence()."""
+
+    element: Type = None  # type: ignore[assignment]
+
+    def __init__(self, element: Type):
+        object.__setattr__(self, "name", f"array({element.name})")
+        object.__setattr__(self, "np_dtype", np.dtype(np.int32))
+        object.__setattr__(self, "is_string", False)
+        object.__setattr__(self, "element", element)
+
+    @property
+    def is_array(self) -> bool:
+        return True
+
+
 _EPOCH = datetime.date(1970, 1, 1)
 
 
@@ -138,6 +166,9 @@ def parse_type(text: str) -> Type:
         return INTEGER
     if t.startswith("varchar"):  # varchar(n): length is not enforced on device
         return VARCHAR
+    if t.startswith("array"):
+        inner = t[t.index("(") + 1 : t.rindex(")")] if "(" in t else "bigint"
+        return ArrayType(parse_type(inner))
     if t.startswith("decimal") or t.startswith("numeric"):
         inner = t[t.index("(") + 1 : t.index(")")] if "(" in t else "18,0"
         parts = [p.strip() for p in inner.split(",")]

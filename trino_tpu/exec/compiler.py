@@ -31,11 +31,11 @@ from ..data.types import Type
 from ..ops.expr import ColumnVal, column_val, eval_expr, eval_predicate
 from ..ops.relops import (
     AggSpec, SortSpec, broadcast_single_row, equi_join, group_aggregate,
-    limit_mask, sort_rows, top_n,
+    limit_mask, sort_rows, top_n, unnest_expand,
 )
 from ..plan.nodes import (
     Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit, PlanNode,
-    Project, RemoteSource, Sort, TableScan, TopN, Values, Window,
+    Project, RemoteSource, Sort, TableScan, TopN, Unnest, Values, Window,
 )
 
 __all__ = ["LocalExecutor"]
@@ -278,6 +278,8 @@ class LocalExecutor:
         def size_of(nid: int, n: PlanNode) -> int:
             if isinstance(n, (TableScan, RemoteSource)):
                 return inputs[str(nid)].capacity
+            if isinstance(n, Values):
+                return max(len(n.rows), 1)
             child_ids = _child_ids(nodes, nid)
             child_sizes = [size_of(c, nodes[c]) for c in child_ids]
             if isinstance(n, (Aggregate, Distinct)):
@@ -302,6 +304,10 @@ class LocalExecutor:
                 # for K plus boundary ties; sort fallback never overflows it
                 caps[nid] = min(_pow2(2 * n.count + 512), _pow2(max(child_sizes[0], 1)))
                 return min(n.count, child_sizes[0])
+            if isinstance(n, Unnest):
+                # unknown fan-out: guess 4x, the retry loop corrects
+                caps[nid] = _pow2(max(child_sizes[0] * 4, 1024))
+                return caps[nid]
             return child_sizes[0]
 
         size_of(0, nodes[0])
@@ -468,7 +474,7 @@ def _trace_plan(
                 None if a.arg is None else eval_expr(a.arg, s.cols, s.capacity)
                 for a in node.aggs
             ]
-            specs = [AggSpec(a.fn, a.distinct) for a in node.aggs]
+            specs = [AggSpec(a.fn, a.distinct, a.param) for a in node.aggs]
             out_keys, out_aggs, out_live, n_groups = group_aggregate(
                 keys, args, specs, s.live, G
             )
@@ -516,6 +522,17 @@ def _trace_plan(
             cols, live, req = equi_join(
                 node.kind, left.cols, left.live, right.cols, right.live,
                 lkeys, rkeys, residual, C,
+            )
+            report(nid, req)
+            return _Stage(cols, live)
+
+        if isinstance(node, Unnest):
+            s = emit(node.child)
+            C = caps[nid]
+            arrays = [eval_expr(a, s.cols, s.capacity) for a in node.arrays]
+            cols, live, req = unnest_expand(
+                s.cols, s.live, arrays, node.element_types,
+                node.with_ordinality, node.outer, C,
             )
             report(nid, req)
             return _Stage(cols, live)

@@ -150,6 +150,13 @@ class SpmdExecutor(LocalExecutor):
                 return caps[nid]
             if isinstance(n, TopN):
                 return min(n.count, child_sizes[0])
+            from ..plan.nodes import Unnest, Values
+
+            if isinstance(n, Values):
+                return max(len(n.rows), 1)
+            if isinstance(n, Unnest):
+                caps[nid] = _pow2(max(child_sizes[0] * 4, 1024))
+                return caps[nid]
             return child_sizes[0]
 
         size_of(0, nodes[0])

@@ -98,6 +98,13 @@ def eval_predicate(e: IrExpr, cols: Sequence[ColumnVal], n: int) -> jnp.ndarray:
 
 
 def _const_val(e: Const, n: int) -> ColumnVal:
+    if e.type.is_array:
+        # array literal: 1-entry dictionary of tuples (same lowering as
+        # string literals); NULL array -> all-invalid codes
+        v = () if e.value is None else tuple(e.value)
+        d = Dictionary(_obj_array([v]))
+        valid = jnp.zeros((n,), dtype=jnp.bool_) if e.value is None else None
+        return ColumnVal(jnp.zeros((n,), dtype=jnp.int32), valid, d, e.type)
     if e.value is None:
         if e.type.is_string:
             # typed NULL varchar (e.g. GROUPING SETS null-extends a key):
@@ -365,6 +372,56 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         out = b.data.astype(jnp.int64) - a.data.astype(jnp.int64)
         return ColumnVal(out, valid, None, e.type)
 
+    if op == "try_cast":
+        # varchar -> T with failures as NULL (reference: TryCastFunction):
+        # parse once per distinct dictionary value on the host
+        a = args[0]
+        target = e.type
+        from ..data.types import date_to_days as _d2d
+
+        parsed, ok = [], []
+        for v in a.dict.values:
+            s = str(v).strip()
+            try:
+                if target == DATE:
+                    p = _d2d(s)
+                elif target.is_decimal:
+                    p = int(round(float(s) * (10.0**target.scale)))
+                elif target.is_floating:
+                    p = float(s)
+                elif target == BOOLEAN:
+                    p = {"true": True, "false": False}[s.lower()]
+                else:
+                    p = int(s)
+                parsed.append(p)
+                ok.append(True)
+            except Exception:
+                parsed.append(0)
+                ok.append(False)
+        table = jnp.asarray(np.asarray(parsed, dtype=target.np_dtype))
+        ok_lane = jnp.take(jnp.asarray(np.asarray(ok, dtype=bool)), a.data)
+        return ColumnVal(
+            jnp.take(table, a.data), _and_valid(a.valid, ok_lane), None, target
+        )
+
+    # ---- json (host maps over the dictionary) -----------------------------
+    if op in ("json_extract_scalar", "json_extract", "json_array_length",
+              "json_size"):
+        return _json_fn(op, e, args, n)
+
+    # ---- arrays (host maps over the dictionary of distinct arrays) --------
+    if op in ("cardinality", "element_at", "contains", "array_position",
+              "array_distinct", "array_sort", "array_join", "array_min",
+              "array_max"):
+        return _array_fn(op, e, args, n)
+    if op == "split":
+        delim = _const_str(e.args[1])
+        a = args[0]
+        new_vals = [tuple(str(v).split(delim)) for v in a.dict.values]
+        uniq, remap = np.unique(_obj_array(new_vals), return_inverse=True)
+        codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+        return ColumnVal(codes, a.valid, Dictionary(uniq), e.type)
+
     # ---- strings (host maps over the dictionary, device gathers) ----------
     if op in _STR_UNARY:
         return _dict_map_str(args[0], _STR_UNARY[op], e.type)
@@ -372,6 +429,272 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
               "regexp_like", "regexp_replace", "regexp_extract", "concat_str"):
         return _string_fn(op, e, args, n)
     raise NotImplementedError(f"call op: {op}")
+
+
+def _json_path(path: str):
+    """Parse the JSONPath subset '$', '$.key', '$[i]', '$.a[1].b'
+    (reference: the json-path grammar JsonPath.g4; this covers the
+    json_extract_scalar usage the docs call the 'simple' paths)."""
+    import re as _re
+
+    if not path.startswith("$"):
+        raise ValueError(f"invalid JSON path: {path!r}")
+    steps = []
+    pos = 0
+    rest = path[1:]
+    for m in _re.finditer(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\[\"([^\"]+)\"\]", rest):
+        if m.start() != pos:  # unparsed segment => unsupported path syntax
+            raise ValueError(f"unsupported JSON path: {path!r}")
+        pos = m.end()
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append(m.group(3))
+    if pos != len(rest):
+        raise ValueError(f"unsupported JSON path: {path!r}")
+    return steps
+
+
+def _json_eval(text: str, steps):
+    import json as _json
+
+    try:
+        v = _json.loads(text)
+    except Exception:
+        return None, False
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or s >= len(v):
+                return None, False
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None, False
+            v = v[s]
+    return v, True
+
+
+def _json_fn(op: str, e: Call, args: list, n: int) -> ColumnVal:
+    """JSON functions over dict-coded varchar: parse each distinct value
+    once on the host (reference: operator/scalar/JsonFunctions +
+    JsonExtract)."""
+    import json as _json
+
+    a = args[0]
+    steps = _json_path(_const_str(e.args[1])) if len(e.args) > 1 else []
+    raw = []
+    for v in a.dict.values:
+        val, found = _json_eval(str(v), steps)
+        if op == "json_extract_scalar":
+            if not found or isinstance(val, (dict, list)) or val is None:
+                raw.append(None)
+            elif isinstance(val, bool):
+                raw.append("true" if val else "false")
+            else:
+                raw.append(str(val))
+        elif op == "json_extract":
+            raw.append(_json.dumps(val, separators=(",", ":")) if found else None)
+        elif op == "json_array_length":
+            raw.append(len(val) if found and isinstance(val, list) else None)
+        else:  # json_size: members of object/array, 0 for scalars
+            if not found:
+                raw.append(None)
+            elif isinstance(val, (dict, list)):
+                raw.append(len(val))
+            else:
+                raw.append(0)
+    ok = np.asarray([r is not None for r in raw], dtype=bool)
+    ok_lane = jnp.take(jnp.asarray(ok), a.data)
+    valid = _and_valid(a.valid, ok_lane)
+    if op in ("json_array_length", "json_size"):
+        table = np.asarray([r if r is not None else 0 for r in raw], dtype=np.int64)
+        return ColumnVal(jnp.take(jnp.asarray(table), a.data), valid, None, e.type)
+    uniq, remap = np.unique(
+        np.asarray([r if r is not None else "" for r in raw], dtype=object),
+        return_inverse=True,
+    )
+    codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+    return ColumnVal(codes, valid, Dictionary(uniq), e.type)
+
+
+def _obj_array(items) -> np.ndarray:
+    """Object ndarray of python values built element-wise (np.asarray would
+    promote equal-length tuples to a 2-D array)."""
+    out = np.empty(len(items), dtype=object)
+    for i, v in enumerate(items):
+        out[i] = v
+    return out
+
+
+def _array_fn(op: str, e: Call, args: list[ColumnVal], n: int) -> ColumnVal:
+    """Array functions over dict-coded ARRAY columns: evaluated once per
+    distinct array on the host, gathered by code on device (the same
+    per-distinct-value strategy as the string ops — data/types.py ArrayType)."""
+    a = args[0]
+    vals = a.dict.values  # object array of tuples
+
+    def scalar_out(table: np.ndarray, dtype, extra_valid=None) -> ColumnVal:
+        t = jnp.asarray(table.astype(dtype))
+        out = jnp.take(t, a.data)
+        valid = a.valid
+        if extra_valid is not None:
+            ok = jnp.take(jnp.asarray(extra_valid), a.data)
+            valid = ok if valid is None else (valid & ok)
+        return ColumnVal(out, valid, None, e.type)
+
+    def array_out(new_vals) -> ColumnVal:
+        uniq, remap = np.unique(_obj_array(new_vals), return_inverse=True)
+        codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+        return ColumnVal(codes, a.valid, Dictionary(uniq), e.type)
+
+    if op == "cardinality":
+        return scalar_out(np.asarray([len(v) for v in vals]), np.int64)
+    if op == "element_at":
+        ix_ir = e.args[1]
+        el_t = e.type
+        if isinstance(ix_ir, Const):
+            i = int(ix_ir.value)
+
+            def pick(v):
+                # 1-based; negative counts from the end; OOB -> NULL
+                if i == 0 or abs(i) > len(v):
+                    return None
+                return v[i - 1] if i > 0 else v[i]
+
+            picked = [pick(v) for v in vals]
+            ok = np.asarray([p is not None for p in picked], dtype=bool)
+            if el_t.is_string:
+                uniq, remap = np.unique(
+                    np.asarray([p if p is not None else "" for p in picked], dtype=object),
+                    return_inverse=True,
+                )
+                codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+                okl = jnp.take(jnp.asarray(ok), a.data)
+                valid = okl if a.valid is None else (a.valid & okl)
+                return ColumnVal(codes, valid, Dictionary(uniq), el_t)
+            table = np.asarray(
+                [p if p is not None else 0 for p in picked], dtype=el_t.np_dtype
+            )
+            return scalar_out(table, el_t.np_dtype, extra_valid=ok)
+        # dynamic index: 2-D padded element table gathered by (code, ix)
+        ix = args[1]
+        lens = np.asarray([len(v) for v in vals], dtype=np.int64)
+        maxlen = max(1, int(lens.max()) if len(lens) else 1)
+        if el_t.is_string:
+            flat = sorted({str(x) for v in vals for x in v}) or [""]
+            ed = Dictionary(np.asarray(flat, dtype=object))
+            mat = np.zeros((len(vals), maxlen), dtype=np.int32)
+            for r, v in enumerate(vals):
+                for c, x in enumerate(v):
+                    mat[r, c] = ed.code_of(str(x))
+        else:
+            ed = None
+            mat = np.zeros((len(vals), maxlen), dtype=el_t.np_dtype)
+            for r, v in enumerate(vals):
+                for c, x in enumerate(v):
+                    mat[r, c] = x
+        ixd = ix.data.astype(jnp.int64)
+        ln = jnp.take(jnp.asarray(lens), a.data)
+        pos = jnp.where(ixd > 0, ixd - 1, ln + ixd)  # 1-based / from-end
+        ok = (pos >= 0) & (pos < ln)
+        pos_c = jnp.clip(pos, 0, maxlen - 1)
+        out = jnp.asarray(mat)[a.data, pos_c]
+        valid = _and_valid(_and_valid(a.valid, ix.valid), ok)
+        return ColumnVal(out, valid, ed, el_t)
+    if op == "contains":
+        x_ir = e.args[1]
+        if isinstance(x_ir, Const):
+            want = x_ir.value
+            table = np.asarray(
+                [any(el == want for el in v) for v in vals], dtype=np.bool_
+            )
+            return scalar_out(table, np.bool_)
+        # dynamic needle: compare against padded 2-D table
+        x = args[1]
+        lens = np.asarray([len(v) for v in vals], dtype=np.int64)
+        maxlen = max(1, int(lens.max()) if len(lens) else 1)
+        if x.dict is not None:
+            # element strings -> needle's code space (-2 == absent, never equal)
+            mat = np.full((len(vals), maxlen), -2, dtype=np.int64)
+            for r, v in enumerate(vals):
+                for c, el in enumerate(v):
+                    mat[r, c] = x.dict.code_of(str(el))
+            needle = x.data.astype(jnp.int64)
+        else:
+            mat = np.zeros((len(vals), maxlen), dtype=np.float64)
+            for r, v in enumerate(vals):
+                for c, el in enumerate(v):
+                    mat[r, c] = el
+            needle = x.data.astype(jnp.float64)
+        rows = jnp.asarray(mat)[a.data]  # [n, maxlen]
+        ln = jnp.take(jnp.asarray(lens), a.data)
+        inlen = jnp.arange(mat.shape[1])[None, :] < ln[:, None]
+        hit = jnp.any((rows == needle[:, None]) & inlen, axis=1)
+        return ColumnVal(hit, _and_valid(a.valid, x.valid), None, BOOLEAN)
+    if op == "array_position":
+        x_ir = e.args[1]
+        assert isinstance(x_ir, Const), "array_position needle must be a literal"
+        want = x_ir.value
+
+        def pos_of(v):
+            for i, el in enumerate(v):
+                if el == want:
+                    return i + 1
+            return 0
+
+        return scalar_out(np.asarray([pos_of(v) for v in vals]), np.int64)
+    if op == "array_distinct":
+        def dedup(v):
+            seen, out = set(), []
+            for x in v:
+                if x not in seen:
+                    seen.add(x)
+                    out.append(x)
+            return tuple(out)
+
+        return array_out([dedup(v) for v in vals])
+    if op == "array_sort":
+        # NULL elements sort last (Trino semantics)
+        return array_out(
+            [
+                tuple(sorted(x for x in v if x is not None))
+                + (None,) * sum(1 for x in v if x is None)
+                for v in vals
+            ]
+        )
+    if op == "array_join":
+        delim = _const_str(e.args[1])
+        strs = [delim.join(str(x) for x in v) for v in vals]
+        uniq, remap = np.unique(np.asarray(strs, dtype=object), return_inverse=True)
+        codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+        return ColumnVal(codes, a.valid, Dictionary(uniq), VARCHAR)
+    if op in ("array_min", "array_max"):
+        # empty -> NULL; any NULL element -> NULL (Trino semantics)
+        f = min if op == "array_min" else max
+        picked = [
+            None if (not len(v) or any(x is None for x in v)) else f(v)
+            for v in vals
+        ]
+        ok = np.asarray([p is not None for p in picked], dtype=bool)
+        if e.type.is_string:
+            uniq, remap = np.unique(
+                np.asarray(
+                    [str(p) if p is not None else "" for p in picked], dtype=object
+                ),
+                return_inverse=True,
+            )
+            codes = jnp.take(jnp.asarray(remap.astype(np.int32)), a.data)
+            okl = jnp.take(jnp.asarray(ok), a.data)
+            return ColumnVal(
+                codes, _and_valid(a.valid, okl), Dictionary(uniq), e.type
+            )
+        table = np.asarray(
+            [p if p is not None else 0 for p in picked], dtype=e.type.np_dtype
+        )
+        return scalar_out(table, e.type.np_dtype, extra_valid=ok)
+    raise NotImplementedError(f"array op {op}")
 
 
 _STR_UNARY = {
@@ -597,16 +920,33 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
             None,
             e.type,
         )
+    evaluated = [
+        (eval_expr(cond, cols, n), eval_expr(res, cols, n)) for cond, res in e.whens
+    ]
+    if out.dict is not None or any(r.dict is not None for _, r in evaluated):
+        # varchar CASE: union the branch dictionaries on the host, remap each
+        # branch's codes into union space, select codes on device — the same
+        # per-distinct-value strategy as every other string op here
+        branches = [out] + [r for _, r in evaluated]
+        if any(b.dict is None for b in branches):
+            raise NotImplementedError("CASE mixing varchar and non-varchar results")
+        union = np.unique(
+            np.concatenate([np.asarray(b.dict.values, dtype=object) for b in branches])
+        )
+        udict = Dictionary(union)
+
+        def remap(b: ColumnVal) -> jnp.ndarray:
+            table = np.searchsorted(union, np.asarray(b.dict.values, dtype=object))
+            return jnp.take(jnp.asarray(table.astype(np.int32)), b.data)
+
+        out = ColumnVal(remap(out), out.valid, udict, e.type)
+        evaluated = [(c, ColumnVal(remap(r), r.valid, udict, e.type)) for c, r in evaluated]
     out_data, out_valid = out.data, out.valid
     result_dict = out.dict
-    for cond, res in reversed(e.whens):
-        c = eval_expr(cond, cols, n)
+    for c, r in reversed(evaluated):
         cm = c.data.astype(jnp.bool_)
         if c.valid is not None:
             cm = cm & c.valid
-        r = eval_expr(res, cols, n)
-        if r.dict is not None or result_dict is not None:
-            raise NotImplementedError("CASE over varchar results")
         out_data = jnp.where(cm, r.data.astype(out_data.dtype), out_data)
         rv = _valid_mask(r) if r.valid is not None else None
         if out_valid is None and rv is None:
@@ -615,7 +955,7 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
             ov = out_valid if out_valid is not None else jnp.ones((n,), jnp.bool_)
             rvm = rv if rv is not None else jnp.ones((n,), jnp.bool_)
             out_valid = jnp.where(cm, rvm, ov)
-    return ColumnVal(out_data, out_valid, None, e.type)
+    return ColumnVal(out_data, out_valid, result_dict, e.type)
 
 
 # ---------------------------------------------------- dictionary (host) ops

@@ -34,14 +34,17 @@ from .expr import ColumnVal
 
 __all__ = [
     "group_aggregate", "equi_join", "broadcast_single_row", "sort_rows",
-    "top_n", "limit_mask", "AggSpec", "SortSpec",
+    "top_n", "limit_mask", "unnest_expand", "AggSpec", "SortSpec",
 ]
 
 
 @dataclass(frozen=True)
 class AggSpec:
-    fn: str  # sum | count | count_star | min | max | avg
+    fn: str  # sum | count | count_star | min | max | avg | bool_and |
+    #          bool_or | stddev_samp | stddev_pop | var_samp | var_pop |
+    #          percentile
     distinct: bool = False
+    param: Optional[float] = None  # percentile's p
 
 
 @dataclass(frozen=True)
@@ -144,39 +147,49 @@ def group_aggregate(
     if fast is not None:
         return fast
 
-    # ---- sort rows by (dead-last, keys..., distinct-agg args...) ----------
-    operands: list[jnp.ndarray] = [(~live).astype(jnp.int8)]
-    for kv in key_vals:
-        operands.append(~_valid_of(kv, n))  # nulls group together (last)
-        operands.append(_sortable_key(kv))
-    distinct_args = [
-        a for a, s in zip(agg_args, specs) if s.distinct and a is not None
+    # ---- sort rows by (dead-last, keys..., [value-sorted agg arg]) --------
+    # value-sorted aggregates (DISTINCT adjacency, percentile selection) ride
+    # the group sort; the FIRST one shares the main sort, each additional one
+    # gets its own sort pass below (group order is key-determined, so segment
+    # ids align across sorts).
+    vs_ix = [
+        i
+        for i, s in enumerate(specs)
+        if (s.distinct or s.fn == "percentile") and agg_args[i] is not None
     ]
-    if len(distinct_args) > 1:
-        raise NotImplementedError("at most one DISTINCT aggregate per node")
-    for da in distinct_args:
-        # validity sorts before the value (as in _global_aggregate) so a NULL
-        # lane whose code equals a live value cannot become the "first
-        # occurrence" and suppress that value's contribution
-        operands.append((~_valid_of(da, n)).astype(jnp.int8))
-        operands.append(_sortable_key(da))
-    iota = jnp.arange(n, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort(operands + [iota], num_keys=len(operands))
-    perm = sorted_ops[-1]
-    live_s = jnp.take(live, perm)
 
-    # ---- group boundaries -------------------------------------------------
-    key_ops = sorted_ops[1 : 1 + 2 * len(key_vals)]
-    diff = jnp.zeros((n,), jnp.bool_)
-    for op in key_ops:
-        prev = jnp.concatenate([op[:1], op[:-1]])
-        diff = diff | (op != prev)
-    first = jnp.zeros((n,), jnp.bool_).at[0].set(True)
-    new_group = live_s & (first | diff)
-    seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-    seg = jnp.where(live_s, seg, G)  # dead rows -> overflow bucket, sliced off
-    seg = jnp.minimum(seg, G)
-    n_groups = jnp.sum(new_group.astype(jnp.int32))
+    def grouped_sort(extra: Optional[ColumnVal]):
+        """Sort by (dead, keys..., extra arg) -> (perm, live_s, seg,
+        new_group, n_groups).  Validity of `extra` sorts before its value so
+        a NULL lane whose code equals a live value cannot become the "first
+        occurrence" (the round-1 COUNT(DISTINCT) advisory bug)."""
+        operands: list[jnp.ndarray] = [(~live).astype(jnp.int8)]
+        for kv in key_vals:
+            operands.append(~_valid_of(kv, n))  # nulls group together (last)
+            operands.append(_sortable_key(kv))
+        if extra is not None:
+            operands.append((~_valid_of(extra, n)).astype(jnp.int8))
+            operands.append(_sortable_key(extra))
+        iota = jnp.arange(n, dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(operands + [iota], num_keys=len(operands))
+        perm = sorted_ops[-1]
+        live_s = jnp.take(live, perm)
+        key_ops = sorted_ops[1 : 1 + 2 * len(key_vals)]
+        diff = jnp.zeros((n,), jnp.bool_)
+        for op in key_ops:
+            prev = jnp.concatenate([op[:1], op[:-1]])
+            diff = diff | (op != prev)
+        first = jnp.zeros((n,), jnp.bool_).at[0].set(True)
+        new_group = live_s & (first | diff)
+        seg = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+        seg = jnp.where(live_s, seg, G)  # dead rows -> overflow bucket
+        seg = jnp.minimum(seg, G)
+        n_groups = jnp.sum(new_group.astype(jnp.int32))
+        return perm, live_s, seg, new_group, n_groups
+
+    perm, live_s, seg, new_group, n_groups = grouped_sort(
+        agg_args[vs_ix[0]] if vs_ix else None
+    )
 
     # ---- output keys: first row of each segment ---------------------------
     out_keys: list[tuple[jnp.ndarray, Optional[jnp.ndarray]]] = []
@@ -190,8 +203,15 @@ def group_aggregate(
     # ---- aggregates -------------------------------------------------------
     out_aggs = _fused_aggs(agg_args, specs, perm, seg, live_s, G, n)
     for i, (arg, spec) in enumerate(zip(agg_args, specs)):
-        if out_aggs[i] is None:  # DISTINCT: needs the sorted-adjacency trick
-            out_aggs[i] = _segment_agg(arg, spec, perm, seg, live_s, new_group, G, n)
+        if out_aggs[i] is None:  # DISTINCT/percentile: need sorted adjacency
+            if i == vs_ix[0]:
+                p, ls, sg, ng = perm, live_s, seg, new_group
+            else:  # additional value-sorted agg: its own sort pass
+                p, ls, sg, ng, _ = grouped_sort(arg)
+            if spec.fn == "percentile":
+                out_aggs[i] = _segment_percentile(arg, spec.param, p, sg, ls, G, n)
+            else:
+                out_aggs[i] = _segment_agg(arg, spec, p, sg, ls, ng, G, n)
 
     out_live = jnp.arange(G, dtype=jnp.int32) < jnp.minimum(n_groups, G)
     return out_keys, out_aggs, out_live, n_groups
@@ -207,7 +227,7 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live):
     reference's DictionaryAwarePageProjection + BigintGroupByHash fast paths
     chase (TPC-H Q1: returnflag x linestatus = 6 groups over 6B rows at
     SF1000); on TPU it turns group-by into a bandwidth-bound reduction."""
-    if any(s.distinct for s in specs):
+    if any(s.distinct or s.fn == "percentile" for s in specs):
         return None
     domains = []
     for kv in key_vals:
@@ -276,7 +296,7 @@ def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
 
     recipe: list = []
     for arg, spec in zip(agg_args, specs):
-        if spec.distinct:
+        if spec.distinct or spec.fn == "percentile":
             recipe.append(None)
             continue
         if spec.fn == "count_star":
@@ -302,6 +322,22 @@ def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
                 )
             else:
                 recipe.append(("minmax", add(SegRed(spec.fn, data, valid)), add_count(valid)))
+        elif spec.fn in ("bool_and", "bool_or"):
+            # AND == min over {0,1}, OR == max (reference: aggregation/
+            # BooleanAndAggregation / BooleanOrAggregation)
+            b = data.astype(jnp.int32)
+            red = "min" if spec.fn == "bool_and" else "max"
+            recipe.append(("bool", add(SegRed(red, b, valid)), add_count(valid)))
+        elif spec.fn in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+            x = data.astype(jnp.float64)
+            recipe.append(
+                (
+                    "var", spec.fn,
+                    add(SegRed("sum", x, valid)),
+                    add(SegRed("sum", x * x, valid)),
+                    add_count(valid),
+                )
+            )
         else:
             raise NotImplementedError(f"aggregate {spec.fn}")
 
@@ -325,6 +361,27 @@ def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
         elif kind == "minmax":
             s, cnt = results[r[1]], results[r[2]]
             out.append((s, cnt > 0))
+        elif kind == "bool":
+            s, cnt = results[r[1]], results[r[2]]
+            out.append((s > 0, cnt > 0))
+        elif kind == "var":
+            _, fn, si, qi, ci = r
+            s, ss, cnt = results[si], results[qi], results[ci]
+            cf = cnt.astype(jnp.float64)
+            safe_n = jnp.where(cnt > 0, cf, 1.0)
+            mean = s / safe_n
+            # population variance; numerical floor at 0 (catastrophic
+            # cancellation on near-constant data)
+            var_pop = jnp.maximum(ss / safe_n - mean * mean, 0.0)
+            if fn.endswith("_pop"):
+                var = var_pop
+                ok = cnt > 0
+            else:
+                var = var_pop * safe_n / jnp.where(cnt > 1, cf - 1.0, 1.0)
+                ok = cnt > 1
+            if fn.startswith("stddev"):
+                var = jnp.sqrt(var)
+            out.append((var, ok))
         else:  # dictmm: map best rank back to a dictionary code
             _, fn, arg, si, ci = r
             best_rank, cnt = results[si], results[ci]
@@ -373,6 +430,32 @@ def _segment_agg(
     return out, None
 
 
+def _segment_percentile(
+    arg: ColumnVal,
+    p: float,
+    perm: jnp.ndarray,
+    seg: jnp.ndarray,
+    live_s: jnp.ndarray,
+    G: int,
+    n: int,
+):
+    """approx_percentile via exact nearest-rank selection on the grouped sort
+    (the sort operands append (validity, value) for this arg, so each group's
+    valid values are contiguous ascending runs).  The reference uses T-digest
+    sketches (aggregation/TDigestAndPercentileAggregation); an exact answer
+    over the sorted page is within any approximation contract and is the
+    natural fit for the sort-based group-by."""
+    data_s = jnp.take(arg.data, perm)
+    valid_s = jnp.take(_valid_of(arg, n), perm) & live_s
+    vcnt = _segment_sum(valid_s.astype(jnp.int64), seg, G + 1)[:G]
+    # group start among sorted rows (seg ascends over live rows, dead == G)
+    starts = jnp.searchsorted(seg, jnp.arange(G, dtype=seg.dtype), side="left")
+    off = jnp.floor(p * jnp.maximum(vcnt - 1, 0).astype(jnp.float64) + 0.5)
+    idx = jnp.clip(starts + off.astype(jnp.int64), 0, max(n - 1, 0))
+    vals = jnp.take(data_s, idx)
+    return vals, vcnt > 0
+
+
 def _global_aggregate(agg_args, specs, live):
     """No GROUP BY: one output row even over empty input (SQL semantics).
 
@@ -397,6 +480,17 @@ def _global_aggregate(agg_args, specs, live):
             first = jnp.zeros((n,), jnp.bool_).at[0].set(True)
             cnt = jnp.sum(((first | (k_s != prev)) & vs).astype(jnp.int64))
             out_aggs.append((cnt.reshape(1), None))
+            continue
+        if spec.fn == "percentile":
+            inv_s, d_s = jax.lax.sort(
+                [(~valid).astype(jnp.int8), arg.data], num_keys=2
+            )
+            vcnt = jnp.sum(valid.astype(jnp.int64))
+            off = jnp.floor(
+                spec.param * jnp.maximum(vcnt - 1, 0).astype(jnp.float64) + 0.5
+            )
+            idx = jnp.clip(off.astype(jnp.int64), 0, max(n - 1, 0))
+            out_aggs.append((jnp.take(d_s, idx).reshape(1), (vcnt > 0).reshape(1)))
             continue
         raise NotImplementedError(spec.fn)  # non-distinct is fully fused above
     out_live = jnp.ones((1,), jnp.bool_)
@@ -746,3 +840,99 @@ def top_n(cols, live, keys, specs, count: int, cap: Optional[int] = None):
 
 def limit_mask(live: jnp.ndarray, count: int) -> jnp.ndarray:
     return live & (jnp.cumsum(live.astype(jnp.int64)) <= count)
+
+
+def unnest_expand(
+    cols: Sequence[ColumnVal],
+    live: jnp.ndarray,
+    arrays: Sequence[ColumnVal],
+    elem_types,
+    with_ordinality: bool,
+    outer: bool,
+    C: int,
+):
+    """Expand rows by array length (reference: operator/unnest/UnnestOperator).
+
+    Arrays are dict-coded (ArrayType): per-row lengths come from a host
+    length table gathered by code; elements come from a padded [n_distinct,
+    maxlen] device matrix.  Expansion is the standard static-shape pattern:
+    exclusive-scan of lengths -> searchsorted row lookup per output lane,
+    with the true required size reported for the capacity-retry loop.
+    Multiple arrays zip (Trino semantics): rows extend to the longest array,
+    shorter arrays NULL-pad.  `outer` emits one NULL-element row for
+    empty/NULL arrays (LEFT JOIN UNNEST ... ON TRUE).
+    """
+    n = int(live.shape[0])
+
+    len_tables = []  # jnp [n_distinct] per array
+    elem_mats = []  # jnp [n_distinct, maxlen] per array
+    elem_dicts = []  # Dictionary | None per array
+    for arr, et in zip(arrays, elem_types):
+        vals = arr.dict.values
+        lens_np = np.asarray([len(v) for v in vals], dtype=np.int64)
+        maxlen = max(1, int(lens_np.max()) if len(lens_np) else 1)
+        if et.is_string:
+            flat = sorted({str(x) for v in vals for x in v}) or [""]
+            ed = Dictionary(np.asarray(flat, dtype=object))
+            mat = np.zeros((len(vals), maxlen), dtype=np.int32)
+            for r, v in enumerate(vals):
+                for c, x in enumerate(v):
+                    mat[r, c] = ed.code_of(str(x))
+        else:
+            ed = None
+            mat = np.zeros((len(vals), maxlen), dtype=et.np_dtype)
+            for r, v in enumerate(vals):
+                for c, x in enumerate(v):
+                    mat[r, c] = 0 if x is None else x
+        len_tables.append(jnp.asarray(lens_np))
+        elem_mats.append(jnp.asarray(mat))
+        elem_dicts.append(ed)
+
+    # per-row expansion length = max over zipped arrays (NULL array -> 0)
+    row_lens = jnp.zeros((n,), dtype=jnp.int64)
+    arr_lens = []
+    for arr, lt in zip(arrays, len_tables):
+        ln = jnp.take(lt, arr.data)
+        if arr.valid is not None:
+            ln = jnp.where(arr.valid, ln, 0)
+        arr_lens.append(ln)
+        row_lens = jnp.maximum(row_lens, ln)
+    row_lens = jnp.where(live, row_lens, 0)
+    pre_outer_lens = row_lens  # before the outer null-extension bump
+    if outer:
+        row_lens = jnp.where(live & (row_lens == 0), 1, row_lens)
+
+    ends = jnp.cumsum(row_lens)  # inclusive scan
+    total = ends[-1] if n else jnp.int64(0)
+    starts = ends - row_lens
+    j = jnp.arange(C, dtype=jnp.int64)
+    src = jnp.searchsorted(ends, j, side="right")
+    src_c = jnp.clip(src, 0, max(n - 1, 0)).astype(jnp.int32)
+    pos = j - jnp.take(starts, src_c)
+    out_live = j < total
+
+    out_cols: list[ColumnVal] = []
+    for cv in cols:
+        data = jnp.take(cv.data, src_c, axis=0)
+        valid = None if cv.valid is None else jnp.take(cv.valid, src_c)
+        out_cols.append(ColumnVal(data, valid, cv.dict, cv.type))
+    for arr, lt, mat, ed, et, ln in zip(
+        arrays, len_tables, elem_mats, elem_dicts, elem_types, arr_lens
+    ):
+        code = jnp.take(arr.data, src_c)
+        in_len = pos < jnp.take(ln, src_c)
+        pos_c = jnp.clip(pos, 0, mat.shape[1] - 1)
+        data = mat[code, pos_c]
+        valid = out_live & in_len
+        if arr.valid is not None:
+            valid = valid & jnp.take(arr.valid, src_c)
+        out_cols.append(ColumnVal(data, valid, ed, et))
+    if with_ordinality:
+        from ..data.types import BIGINT
+
+        # outer null-extension rows carry NULL ordinality (Trino semantics)
+        ord_valid = None
+        if outer:
+            ord_valid = out_live & (pos < jnp.take(pre_outer_lens, src_c))
+        out_cols.append(ColumnVal(pos + 1, ord_valid, None, BIGINT))
+    return out_cols, out_live, total

@@ -114,6 +114,8 @@ def window_eval(
     peer_end = _end_indices(is_peer_end)
     ones = jnp.ones((n,), jnp.int64)
     row_number = _seg_scan("add", ones, new_part)
+    idx32 = jnp.arange(n, dtype=jnp.int32)
+    part_start = _seg_scan("max", jnp.where(new_part, idx32, -1), new_part)
 
     # ---- evaluate calls ----------------------------------------------------
     for call, argv in zip(calls, arg_vals):
@@ -121,13 +123,36 @@ def window_eval(
         out_cols.append(
             _eval_call(
                 call, argv, n, new_part, new_peer, part_end, peer_end,
-                row_number, live_s,
+                row_number, live_s, part_start,
             )
         )
     return out_cols, live_s
 
 
-def _eval_call(call, argv, n, new_part, new_peer, part_end, peer_end, row_number, live_s):
+def _literal_arg(call, i: int, argv, default=None) -> int:
+    """Literal int parameter (lag/lead offset, ntile buckets, nth_value n):
+    read from the Const IR on the call — the evaluated lane array is a traced
+    constant under jit and cannot concretize."""
+    from ..plan.ir import Const
+
+    if len(call.args) <= i:
+        return default
+    e = call.args[i]
+    if isinstance(e, Const) and e.value is not None:
+        return int(e.value)
+    return int(argv[i].data[0])  # eager path fallback
+
+
+def _frame_bounds(frame: str):
+    """'rows:<lo>:<hi>' -> (lo, hi) with 'u' or signed int offsets."""
+    _, lo, hi = frame.split(":")
+    return (lo if lo == "u" else int(lo)), (hi if hi == "u" else int(hi))
+
+
+def _eval_call(
+    call, argv, n, new_part, new_peer, part_end, peer_end, row_number, live_s,
+    part_start,
+):
     from ..data.types import BIGINT
 
     fn = call.fn
@@ -143,29 +168,90 @@ def _eval_call(call, argv, n, new_part, new_peer, part_end, peer_end, row_number
         return ColumnVal(dr, None, None, call.type)
     if fn in ("lag", "lead"):
         a = argv[0]
-        k = int(argv[1].data[0]) if len(argv) > 1 else 1
+        k = _literal_arg(call, 1, argv, default=1)
         shift = -k if fn == "lag" else k
         data = jnp.roll(a.data, -shift)
         valid = jnp.roll(_valid_of(a, n), -shift)
-        # valid only if the source row is in the same partition
+        # the source row must exist in the same partition; a NULL value AT an
+        # existing source row stays NULL (the default only covers rows where
+        # the offset leaves the partition — reference: LagFunction semantics)
         pid = jnp.cumsum(new_part.astype(jnp.int32))
         src_pid = jnp.roll(pid, -shift)
         idx = jnp.arange(n)
-        in_range = (idx + shift >= 0) & (idx + shift < n)
-        ok = valid & (pid == src_pid) & in_range
+        exists = (pid == src_pid) & (idx + shift >= 0) & (idx + shift < n)
+        ok = valid & exists
         if len(argv) > 2:  # lag(x, k, default)
             dflt = argv[2]
-            data = jnp.where(ok, data, dflt.data.astype(data.dtype))
-            ok = ok | _valid_of(dflt, n)
+            if a.dict is not None:
+                # merge dictionaries so the default's code lands in the same
+                # code space as the value column (a raw code-0 substitution
+                # would alias whatever a.dict[0] happens to be)
+                import numpy as _np
+
+                union = _np.unique(
+                    _np.concatenate(
+                        [
+                            _np.asarray(a.dict.values, dtype=object),
+                            _np.asarray(dflt.dict.values, dtype=object),
+                        ]
+                    )
+                )
+                from ..data.page import Dictionary as _Dict
+
+                ra = _np.searchsorted(union, _np.asarray(a.dict.values, dtype=object))
+                rd = _np.searchsorted(union, _np.asarray(dflt.dict.values, dtype=object))
+                data = jnp.take(jnp.asarray(ra.astype(_np.int32)), data)
+                ddata = jnp.take(jnp.asarray(rd.astype(_np.int32)), dflt.data)
+                data = jnp.where(exists, data, ddata)
+                ok = jnp.where(exists, ok, _valid_of(dflt, n))
+                return ColumnVal(data, ok, _Dict(union), call.type)
+            data = jnp.where(exists, data, dflt.data.astype(data.dtype))
+            ok = jnp.where(exists, ok, _valid_of(dflt, n))
         return ColumnVal(data, ok, a.dict, call.type)
     if fn == "first_value":
         a = argv[0]
-        # value at partition start: running 'carry first' via masked max of idx
-        idx = jnp.arange(n, dtype=jnp.int32)
-        start_idx = _seg_scan("max", jnp.where(new_part, idx, -1), new_part)
-        data = jnp.take(a.data, start_idx)
-        valid = None if a.valid is None else jnp.take(a.valid, start_idx)
+        data = jnp.take(a.data, part_start)
+        valid = None if a.valid is None else jnp.take(a.valid, part_start)
         return ColumnVal(data, valid, a.dict, call.type)
+    if fn == "nth_value":
+        a = argv[0]
+        k = _literal_arg(call, 1, argv)
+        pos = part_start + (k - 1)
+        # frame-aware: the k-th row must be INSIDE the row's frame — for the
+        # default RANGE frame that ends at the current peer group, for ROWS
+        # at the current row, for 'whole' at the partition end (reference:
+        # window/FrameInfo-bounded NthValueFunction)
+        i32f = jnp.arange(n, dtype=jnp.int32)
+        if call.frame == "whole":
+            frame_end = part_end
+        elif call.frame == "rows":
+            frame_end = i32f
+        else:  # range (peers included)
+            frame_end = peer_end
+        ok = (pos <= frame_end) & (pos <= part_end)
+        pos_c = jnp.clip(pos, 0, n - 1)
+        data = jnp.take(a.data, pos_c)
+        valid = ok if a.valid is None else (ok & jnp.take(a.valid, pos_c))
+        return ColumnVal(data, valid, a.dict, call.type)
+    if fn == "ntile":
+        k = _literal_arg(call, 0, argv)
+        size = jnp.take(row_number, part_end)
+        tile = (row_number - 1) * k // jnp.maximum(size, 1) + 1
+        return ColumnVal(tile, None, None, call.type)
+    if fn == "percent_rank":
+        size = jnp.take(row_number, part_end)
+        start_rn = jnp.where(new_peer, row_number, jnp.int64(0))
+        rank = _seg_scan("max", start_rn, new_part)
+        denom = jnp.maximum(size - 1, 1).astype(jnp.float64)
+        pr = jnp.where(size > 1, (rank - 1).astype(jnp.float64) / denom, 0.0)
+        return ColumnVal(pr, None, None, call.type)
+    if fn == "cume_dist":
+        size = jnp.take(row_number, part_end)
+        peers_through = jnp.take(row_number, peer_end)
+        return ColumnVal(
+            peers_through.astype(jnp.float64) / jnp.maximum(size, 1).astype(jnp.float64),
+            None, None, call.type,
+        )
     if fn == "last_value":
         a = argv[0]
         end = part_end if call.frame == "whole" else peer_end
@@ -173,16 +259,52 @@ def _eval_call(call, argv, n, new_part, new_peer, part_end, peer_end, row_number
         valid = None if a.valid is None else jnp.take(a.valid, end)
         return ColumnVal(data, valid, a.dict, call.type)
 
-    # aggregates over a prefix frame ----------------------------------------
+    # aggregates over a frame -----------------------------------------------
+    # prefix frames use running scans + peer/partition-end gathers; general
+    # ROWS offset frames ('rows:<lo>:<hi>') use prefix DIFFERENCES for
+    # sum/count/avg and shifted-lane or directional scans for min/max
+    # (reference: window/FrameInfo + per-row frame walk in WindowPartition)
+    offset_frame = call.frame.startswith("rows:")
+    if offset_frame:
+        lo, hi = _frame_bounds(call.frame)
+        i32 = jnp.arange(n, dtype=jnp.int32)
+        hi_idx = part_end if hi == "u" else jnp.minimum(i32 + hi, part_end)
+        lo_idx = part_start if lo == "u" else jnp.maximum(i32 + lo, part_start)
+        empty = lo_idx > hi_idx
+
+        def frame_sum(contrib):
+            running = _seg_scan("add", contrib, new_part)
+            s_hi = jnp.take(running, jnp.clip(hi_idx, 0, n - 1))
+            s_lo = jnp.where(
+                lo_idx > part_start,
+                jnp.take(running, jnp.clip(lo_idx - 1, 0, n - 1)),
+                jnp.zeros_like(running),
+            )
+            return jnp.where(empty, jnp.zeros_like(running), s_hi - s_lo)
+
     if fn == "count_star":
-        running = _seg_scan("add", live_s.astype(jnp.int64), new_part)
-        return ColumnVal(_frame_value(running, call.frame, part_end, peer_end), None, None, call.type)
+        c = (
+            frame_sum(live_s.astype(jnp.int64))
+            if offset_frame
+            else _frame_value(
+                _seg_scan("add", live_s.astype(jnp.int64), new_part),
+                call.frame, part_end, peer_end,
+            )
+        )
+        return ColumnVal(c, None, None, call.type)
 
     a = argv[0]
     valid = _valid_of(a, n) & live_s
     if fn == "count":
-        running = _seg_scan("add", valid.astype(jnp.int64), new_part)
-        return ColumnVal(_frame_value(running, call.frame, part_end, peer_end), None, None, call.type)
+        c = (
+            frame_sum(valid.astype(jnp.int64))
+            if offset_frame
+            else _frame_value(
+                _seg_scan("add", valid.astype(jnp.int64), new_part),
+                call.frame, part_end, peer_end,
+            )
+        )
+        return ColumnVal(c, None, None, call.type)
     if fn in ("sum", "avg"):
         acc_t = (
             jnp.float64
@@ -190,10 +312,17 @@ def _eval_call(call, argv, n, new_part, new_peer, part_end, peer_end, row_number
             else jnp.int64
         )
         contrib = jnp.where(valid, a.data.astype(acc_t), jnp.zeros((n,), acc_t))
-        rsum = _seg_scan("add", contrib, new_part)
-        rcnt = _seg_scan("add", valid.astype(jnp.int64), new_part)
-        s = _frame_value(rsum, call.frame, part_end, peer_end)
-        c = _frame_value(rcnt, call.frame, part_end, peer_end)
+        if offset_frame:
+            s = frame_sum(contrib)
+            c = frame_sum(valid.astype(jnp.int64))
+        else:
+            s = _frame_value(
+                _seg_scan("add", contrib, new_part), call.frame, part_end, peer_end
+            )
+            c = _frame_value(
+                _seg_scan("add", valid.astype(jnp.int64), new_part),
+                call.frame, part_end, peer_end,
+            )
         if fn == "sum":
             return ColumnVal(s, c > 0, None, call.type)
         return ColumnVal(
@@ -209,7 +338,37 @@ def _eval_call(call, argv, n, new_part, new_peer, part_end, peer_end, row_number
             info = jnp.iinfo(a.data.dtype)
             sent = jnp.asarray(info.max if fn == "min" else info.min, a.data.dtype)
         x = jnp.where(valid, a.data, sent)
-        r = _seg_scan("min" if fn == "min" else "max", x, new_part)
+        red = "min" if fn == "min" else "max"
+        if offset_frame:
+            c = frame_sum(valid.astype(jnp.int64))
+            if lo != "u" and hi != "u":
+                width = hi - lo + 1
+                if width > 128:
+                    raise NotImplementedError("ROWS frame wider than 128 for min/max")
+                pid = jnp.cumsum(new_part.astype(jnp.int32))
+                acc = jnp.full((n,), sent)
+                for s_off in range(lo, hi + 1):
+                    shifted = jnp.roll(x, -s_off)
+                    src_pid = jnp.roll(pid, -s_off)
+                    in_rng = (i32 + s_off >= 0) & (i32 + s_off < n) & (src_pid == pid)
+                    cand = jnp.where(in_rng, shifted, sent)
+                    acc = jnp.minimum(acc, cand) if fn == "min" else jnp.maximum(acc, cand)
+                return ColumnVal(acc, c > 0, None, call.type)
+            if lo == "u":  # [part_start, i+hi]: forward scan gathered at hi
+                r = _seg_scan(red, x, new_part)
+                v = jnp.take(r, jnp.clip(hi_idx, 0, n - 1))
+                return ColumnVal(v, c > 0, None, call.type)
+            # hi == 'u': [i+lo, part_end]: suffix scan gathered at lo
+            rsuf = jnp.flip(
+                _seg_scan(
+                    red,
+                    jnp.flip(x),
+                    jnp.flip(jnp.concatenate([new_part[1:], jnp.ones((1,), jnp.bool_)])),
+                )
+            )
+            v = jnp.take(rsuf, jnp.clip(lo_idx, 0, n - 1))
+            return ColumnVal(v, c > 0, None, call.type)
+        r = _seg_scan(red, x, new_part)
         rc = _seg_scan("add", valid.astype(jnp.int64), new_part)
         v = _frame_value(r, call.frame, part_end, peer_end)
         c = _frame_value(rc, call.frame, part_end, peer_end)

@@ -166,6 +166,20 @@ class _Distributor:
                 new_inputs.append(cc)
             return Concat(tuple(new_inputs)), _Part("any")
 
+        from .nodes import Unnest as _Unnest
+
+        if isinstance(node, _Unnest):
+            # row-local expansion: child columns keep their indices, so any
+            # hash partitioning on them is preserved
+            child, part = self.visit(node.child)
+            return (
+                _Unnest(
+                    child, node.arrays, node.element_names, node.element_types,
+                    node.with_ordinality, node.outer, node.ordinality_name,
+                ),
+                part,
+            )
+
         if isinstance(node, Window):
             child, part = self.visit(node.child)
             if part.kind == "replicated":
@@ -215,8 +229,17 @@ class _Distributor:
                 part,
             )
 
+        # aggregates whose state does not combine by re-applying the same fn
+        # must see raw rows: repartition (or gather, keyless) then aggregate
+        # once (the reference splits these via intermediate state types;
+        # raw-row repartition is the simpler TPU-shaped equivalent)
+        _raw_only = {"percentile", "stddev_samp", "stddev_pop", "var_samp", "var_pop"}
         has_distinct = any(a.distinct for a in node.aggs)
-        if has_distinct:
+        if has_distinct or any(a.fn in _raw_only for a in node.aggs):
+            if nk == 0:
+                exch = Exchange(child, "gather")
+                out = Aggregate(exch, (), node.aggs, node.names, "single")
+                return out, _Part("replicated")
             # repartition raw rows on the group keys, then aggregate once
             exch = Exchange(child, "repartition", node.group_keys)
             out = Aggregate(exch, node.group_keys, node.aggs, node.names, "single")

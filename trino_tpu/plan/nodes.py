@@ -22,7 +22,7 @@ from .ir import IrExpr
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
     "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
-    "Exchange",
+    "Exchange", "Unnest",
 ]
 
 
@@ -91,13 +91,16 @@ class Project(PlanNode):
 
 @dataclass(frozen=True)
 class AggCall:
-    """One aggregate: fn in {sum, count, min, max, avg, count_star};
-    arg is None only for count_star. distinct per-agg (count(distinct x))."""
+    """One aggregate: fn in {sum, count, min, max, avg, count_star, bool_and,
+    bool_or, stddev_samp, stddev_pop, var_samp, var_pop, percentile};
+    arg is None only for count_star. distinct per-agg (count(distinct x)).
+    param: extra literal parameter (approx_percentile's p)."""
 
     fn: str
     arg: Optional[IrExpr]
     type: Type
     distinct: bool = False
+    param: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -330,6 +333,40 @@ class Window(PlanNode):
 
 
 @dataclass(frozen=True)
+class Unnest(PlanNode):
+    """Array expansion (reference: UnnestNode -> operator/unnest/
+    UnnestOperator).  Output schema = child columns ++ one element column per
+    array ++ optional BIGINT ordinality.  Arrays are dictionary-coded
+    (data/types.py ArrayType); the kernel expands rows by per-row length with
+    the standard capacity-retry protocol.  `outer` keeps empty-array rows
+    with NULL elements (LEFT JOIN UNNEST ... ON TRUE)."""
+
+    child: PlanNode
+    arrays: tuple[IrExpr, ...]
+    element_names: tuple[str, ...]
+    element_types: tuple[Type, ...]
+    with_ordinality: bool = False
+    outer: bool = False
+    ordinality_name: str = "ordinality"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        extra = (self.ordinality_name,) if self.with_ordinality else ()
+        return self.child.output_names + self.element_names + extra
+
+    @property
+    def output_types(self):
+        from ..data.types import BIGINT
+
+        extra = (BIGINT,) if self.with_ordinality else ()
+        return self.child.output_types + self.element_types + extra
+
+
+@dataclass(frozen=True)
 class Exchange(PlanNode):
     """Data redistribution boundary (reference: ExchangeNode inserted by
     AddExchanges.java:143; physically PartitionedOutputOperator -> HTTP ->
@@ -421,6 +458,10 @@ def format_plan(
         detail = f" {node.kind}" + (
             f" keys={[str(k) for k in node.keys]}" if node.keys else ""
         )
+    elif isinstance(node, Unnest):
+        detail = f" {[str(a) for a in node.arrays]}" + (
+            " with ordinality" if node.with_ordinality else ""
+        ) + (" outer" if node.outer else "")
     suffix = annotations.get(nid, "") if annotations else ""
     lines = [f"{pad}{label}{detail}{suffix}"]
     for c in node.children:

@@ -19,7 +19,8 @@ from typing import Optional
 from .ir import FieldRef, IrExpr, field_refs, remap
 from .nodes import (
     Aggregate, AggCall, Concat, Distinct, Filter, Join, Limit, PlanNode,
-    Project, Sort, SortKey, TableScan, TopN, Values, Window, WindowCall,
+    Project, Sort, SortKey, TableScan, TopN, Unnest, Values, Window,
+    WindowCall,
 )
 
 __all__ = ["optimize", "prune_columns"]
@@ -86,6 +87,7 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
                 None if node.aggs[i].arg is None else remap(node.aggs[i].arg, m),
                 node.aggs[i].type,
                 node.aggs[i].distinct,
+                node.aggs[i].param,
             )
             for i in keep_aggs
         )
@@ -171,6 +173,28 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
             new_inputs.append(Project(pc, exprs, names))
         mapping = {old: pos for pos, old in enumerate(keep)}
         return Concat(tuple(new_inputs)), mapping
+
+    if isinstance(node, Unnest):
+        nc = len(node.child.output_types)
+        n_el = len(node.arrays)
+        child_needed = {i for i in needed if i < nc}
+        for a in node.arrays:
+            child_needed |= field_refs(a)
+        child, m = _prune(node.child, child_needed)
+        new_nc = len(child.output_types)
+        new = Unnest(
+            child,
+            tuple(remap(a, m) for a in node.arrays),
+            node.element_names,
+            node.element_types,
+            node.with_ordinality,
+            node.outer,
+            node.ordinality_name,
+        )
+        mapping = dict(m)
+        for i in range(n_el + (1 if node.with_ordinality else 0)):
+            mapping[nc + i] = new_nc + i
+        return new, mapping
 
     if isinstance(node, Window):
         nc = len(node.child.output_types)

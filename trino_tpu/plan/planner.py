@@ -41,7 +41,7 @@ from ..sql.parser import parse
 from .ir import Call, CaseWhen, Const, FieldRef, InListIr, IrExpr, LikeIr
 from .nodes import (
     AggCall, Aggregate, Distinct, Filter, Join, Limit, PlanNode, Project,
-    Sort, SortKey, TableScan, TopN,
+    Sort, SortKey, TableScan, TopN, Unnest,
 )
 
 __all__ = ["Planner", "PlanningError"]
@@ -51,7 +51,12 @@ class PlanningError(Exception):
     pass
 
 
-_AGG_FNS = {"sum", "count", "min", "max", "avg"}
+_AGG_FNS = {
+    "sum", "count", "min", "max", "avg",
+    "approx_distinct", "approx_percentile", "count_if",
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "bool_and", "bool_or", "every", "arbitrary", "any_value",
+}
 
 _CMP_OPS = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
 _CMP_FLIP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
@@ -357,7 +362,22 @@ class Planner:
 
             return RelationPlan(Values((), (), ((),)), [])
 
-        plans = [self._plan_relation(r, outer, ctes) for r in relations]
+        # UNNEST items in a FROM list are lateral: they may reference columns
+        # of the other FROM items, so they apply AFTER the base join (the
+        # reference plans them as lateral join unnests,
+        # RelationPlanner.planJoinUnnest)
+        unnest_items = [r for r in relations if isinstance(r, A.UnnestRelation)]
+        base = tuple(r for r in relations if not isinstance(r, A.UnnestRelation))
+        if not base:
+            from .nodes import Values
+
+            joined0 = RelationPlan(Values((), (), ((),)), [])
+            for u in unnest_items:
+                joined0 = self._plan_unnest(joined0, u, outer)
+            unnest_items = []
+            plans: list[RelationPlan] = [joined0]
+        else:
+            plans = [self._plan_relation(r, outer, ctes) for r in base]
 
         conjuncts = _split_conjuncts(where) if where is not None else []
         conjuncts = [_extract_common_or_conjuncts(c) for c in conjuncts]
@@ -426,6 +446,34 @@ class Planner:
                 f.name if f.name is not None else f"_h{i}" for i, f in enumerate(want)
             )
             joined = RelationPlan(Project(joined.node, exprs, names), want)
+
+        # lateral UNNEST items apply over the joined base relations; residual
+        # predicates after them so they can reference unnested columns
+        unnest_fields: list[list[Field]] = []
+        for u in unnest_items:
+            before = len(joined.fields)
+            joined = self._plan_unnest(joined, u, outer)
+            unnest_fields.append(list(joined.fields[before:]))
+        if unnest_items:
+            # restore WRITTEN FROM-list order (an unnest before a table must
+            # contribute its columns first in SELECT *), same invariant as
+            # the join-order restoration above
+            base_iter = iter(plans)
+            ufield_iter = iter(unnest_fields)
+            want2: list[Field] = []
+            for r in relations:
+                if isinstance(r, A.UnnestRelation):
+                    want2.extend(next(ufield_iter))
+                else:
+                    want2.extend(next(base_iter).fields)
+            if [id(f) for f in joined.fields] != [id(f) for f in want2]:
+                pos = {id(f): i for i, f in enumerate(joined.fields)}
+                exprs = tuple(FieldRef(pos[id(f)], f.type) for f in want2)
+                names2 = tuple(
+                    f.name if f.name is not None else f"_h{i}"
+                    for i, f in enumerate(want2)
+                )
+                joined = RelationPlan(Project(joined.node, exprs, names2), want2)
 
         # residual multi-relation predicates
         node = joined.node
@@ -516,7 +564,27 @@ class Planner:
             return RelationPlan(
                 sub.node, [Field(r.alias, f.name, f.type) for f in sub.fields]
             )
+        if isinstance(r, A.UnnestRelation):
+            from .nodes import Values
+
+            # standalone UNNEST (no lateral references)
+            return self._plan_unnest(
+                RelationPlan(Values((), (), ((),)), []), r, outer
+            )
         if isinstance(r, A.JoinRelation):
+            if isinstance(r.right, A.UnnestRelation):
+                # [CROSS | LEFT] JOIN UNNEST(expr): lateral over the left side
+                # (reference: RelationPlanner.planJoinUnnest)
+                left = self._plan_relation(r.left, outer, ctes)
+                if r.kind not in ("cross", "inner", "left"):
+                    raise PlanningError(f"{r.kind} JOIN UNNEST not supported")
+                if r.on is not None and not (
+                    isinstance(r.on, A.BoolLit) and r.on.value
+                ):
+                    raise PlanningError("JOIN UNNEST requires ON TRUE")
+                return self._plan_unnest(
+                    left, r.right, outer, outer_join=(r.kind == "left")
+                )
             left = self._plan_relation(r.left, outer, ctes)
             right = self._plan_relation(r.right, outer, ctes)
             if r.kind == "cross":
@@ -540,6 +608,56 @@ class Planner:
         names = tuple(rel.fields[i].name or f"_c{k}" for k, i in enumerate(perm))
         node = Project(rel.node, exprs, names)
         return RelationPlan(node, [rel.fields[i] for i in perm])
+
+    def _plan_unnest(
+        self,
+        rel: RelationPlan,
+        u: A.UnnestRelation,
+        outer: Optional[Scope],
+        outer_join: bool = False,
+    ) -> RelationPlan:
+        """Lateral array expansion over `rel` (reference: UnnestNode via
+        RelationPlanner.planJoinUnnest; executed by ops/relops.py
+        unnest_expand)."""
+        t = _Translator(rel.scope, outer)
+        irs: list[IrExpr] = []
+        elem_types: list[Type] = []
+        for e in u.exprs:
+            ir = t.translate(e)
+            if not ir.type.is_array:
+                raise PlanningError(f"UNNEST argument must be an array, got {ir.type}")
+            irs.append(ir)
+            elem_types.append(ir.type.element)
+        n_el = len(irs)
+        if u.column_aliases:
+            expected = n_el + (1 if u.with_ordinality else 0)
+            if len(u.column_aliases) not in (n_el, expected):
+                raise PlanningError(
+                    f"UNNEST column aliases: got {len(u.column_aliases)}, "
+                    f"expected {n_el} (+1 with ordinality)"
+                )
+            names = list(u.column_aliases[:n_el])
+            ord_name = (
+                u.column_aliases[n_el]
+                if len(u.column_aliases) > n_el
+                else "ordinality"
+            )
+        else:
+            names = [
+                e.parts[-1] if isinstance(e, A.Ident) else f"unnest_{i}"
+                for i, e in enumerate(u.exprs)
+            ]
+            ord_name = "ordinality"
+        node = Unnest(
+            rel.node, tuple(irs), tuple(names), tuple(elem_types),
+            u.with_ordinality, outer_join, ord_name,
+        )
+        fields = list(rel.fields) + [
+            Field(u.alias, nm, tt) for nm, tt in zip(names, elem_types)
+        ]
+        if u.with_ordinality:
+            fields.append(Field(u.alias, ord_name, BIGINT))
+        return RelationPlan(node, fields)
 
     def _plan_subquery_relation(
         self, q: A.Query, outer: Optional[Scope], ctes: dict[str, A.Query]
@@ -616,12 +734,56 @@ class Planner:
                 aggs.append(AggCall("count_star", None, BIGINT))
                 continue
             arg = t.translate(fc.args[0])
-            if fc.name == "avg" and arg.type.is_decimal:
+            name = fc.name
+            # rewrites to the kernel-level aggregate set (reference: 224
+            # accumulator files; here a small orthogonal core + rewrites)
+            if name == "count_if":
+                arg = CaseWhen(
+                    ((_as_bool(arg), Const(1, BIGINT)),), Const(0, BIGINT), BIGINT
+                )
+                aggs.append(AggCall("sum", arg, BIGINT))
+                continue
+            if name == "approx_distinct":
+                # exact distinct count satisfies any approximation contract;
+                # the sort-based group-by gives it for free (vs the
+                # reference's HLL sketches, aggregation/ApproximateCountDistinct)
+                aggs.append(AggCall("count", arg, BIGINT, distinct=True))
+                continue
+            if name == "approx_percentile":
+                if not arg.type.is_numeric:
+                    raise PlanningError("approx_percentile requires numeric input")
+                p_ir = t.translate(fc.args[1])
+                if not isinstance(p_ir, Const):
+                    raise PlanningError("approx_percentile fraction must be a literal")
+                p = float(p_ir.value)
+                if p_ir.type.is_decimal:
+                    p /= 10.0 ** p_ir.type.scale
+                if not (0.0 <= p <= 1.0):
+                    raise PlanningError("percentile fraction must be in [0, 1]")
+                aggs.append(AggCall("percentile", arg, arg.type, param=p))
+                continue
+            if name in ("arbitrary", "any_value"):
+                # deterministic choice (min) — any value qualifies
+                aggs.append(AggCall("min", arg, arg.type))
+                continue
+            if name == "every":
+                name = "bool_and"
+            if name == "stddev":
+                name = "stddev_samp"
+            if name == "variance":
+                name = "var_samp"
+            if name in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+                aggs.append(AggCall(name, _cast_ir(arg, DOUBLE), DOUBLE))
+                continue
+            if name in ("bool_and", "bool_or"):
+                aggs.append(AggCall(name, _as_bool(arg), BOOLEAN))
+                continue
+            if name == "avg" and arg.type.is_decimal:
                 # avg over decimals divides at the end in f64; feeding the
                 # accumulator doubles keeps relops scale-agnostic
                 arg = _cast_ir(arg, DOUBLE)
-            out_t = _agg_type(fc.name, arg.type)
-            aggs.append(AggCall(fc.name, arg, out_t, fc.distinct))
+            out_t = _agg_type(name, arg.type)
+            aggs.append(AggCall(name, arg, out_t, fc.distinct))
         return aggs
 
     def _plan_grouping_sets(
@@ -672,7 +834,13 @@ class Planner:
             FieldRef(K + n_child, BIGINT)
         ]
         shifted = [
-            AggCall(a.fn, None if a.arg is None else remap(a.arg, shift), a.type, a.distinct)
+            AggCall(
+                a.fn,
+                None if a.arg is None else remap(a.arg, shift),
+                a.type,
+                a.distinct,
+                a.param,
+            )
             for a in aggs
         ]
         names = tuple(f"_g{i}" for i in range(K + 1)) + tuple(
@@ -800,20 +968,38 @@ class Planner:
                     # window accumulators run in f64 lanes; decimals enter as
                     # doubles (exact to 2^53 on the CPU; see ops/window.py)
                     args = (_cast_ir(args[0], DOUBLE),) + args[1:]
-                if fn in ("row_number", "rank", "dense_rank"):
+                if fn in ("lag", "lead") and len(args) > 2:
+                    # the default must land in the value column's lanes (a
+                    # decimal literal would otherwise inject raw scaled ints)
+                    args = args[:2] + (_cast_ir(args[2], args[0].type),)
+                if fn in ("row_number", "rank", "dense_rank", "ntile"):
                     out_t = BIGINT
                 elif fn == "count":
                     out_t = BIGINT
                     if not args:
                         fn = "count_star"
-                elif fn == "avg":
+                elif fn in ("avg", "percent_rank", "cume_dist"):
                     out_t = DOUBLE
                 elif fn == "sum":
                     out_t = _agg_type("sum", args[0].type)
-                elif fn in ("min", "max", "lag", "lead", "first_value", "last_value"):
+                elif fn in ("min", "max", "lag", "lead", "first_value",
+                            "last_value", "nth_value"):
                     out_t = args[0].type
                 else:
                     raise PlanningError(f"unknown window function: {fn}")
+                if frame.startswith("rows:") and fn not in (
+                    "sum", "avg", "count", "count_star", "min", "max"
+                ):
+                    raise PlanningError(
+                        f"offset frame not supported for window function {fn}"
+                    )
+                if fn == "ntile" and not (args and isinstance(args[0], Const)):
+                    raise PlanningError("ntile() requires a literal bucket count")
+                if fn == "nth_value":
+                    if len(args) < 2 or not isinstance(args[1], Const):
+                        raise PlanningError("nth_value() requires a literal n")
+                if fn in ("lag", "lead") and len(args) > 1 and not isinstance(args[1], Const):
+                    raise PlanningError(f"{fn}() offset must be a literal")
                 calls.append(WindowCall(fn, args, out_t, frame))
             names = tuple(f"_w{base + i}" for i in range(len(calls)))
             node = Window(rel.node, part_irs, keys, tuple(calls), names)
@@ -1169,7 +1355,18 @@ class _Translator:
             from ..data.types import parse_type
 
             target = parse_type(e.type_name)
-            return _cast_ir(self.translate(e.operand), target)
+            operand = self.translate(e.operand)
+            if e.try_ and operand.type == VARCHAR and target != VARCHAR:
+                # TRY_CAST from varchar: parse failures are NULL, not errors
+                # (reference: scalar/TryCastFunction); non-string casts in
+                # this engine cannot fail, so they lower to a plain cast
+                if isinstance(operand, Const):
+                    try:
+                        return _cast_ir(operand, target)
+                    except Exception:
+                        return Const(None, target)
+                return Call("try_cast", (operand,), target)
+            return _cast_ir(operand, target)
         if isinstance(e, A.Between):
             a = self.translate(e.operand)
             lo = self.translate(e.low)
@@ -1405,6 +1602,81 @@ class _Translator:
             return Call("starts_with", args, BOOLEAN)
         if name == "regexp_like":
             return Call("regexp_like", args, BOOLEAN)
+
+        # ---- json (over varchar lanes) -------------------------------------
+        if name in ("json_extract_scalar", "json_extract"):
+            if args[0].type != VARCHAR:
+                raise PlanningError(f"{name} requires varchar json input")
+            return Call(name, args, VARCHAR)
+        if name in ("json_array_length", "json_size"):
+            if args[0].type != VARCHAR:
+                raise PlanningError(f"{name} requires varchar json input")
+            return Call(name, args, BIGINT)
+
+        # ---- arrays (data/types.py ArrayType: dict-coded distinct tuples) --
+        from ..data.types import ArrayType
+
+        if name == "array_constructor":
+            if not args:
+                return Const((), ArrayType(UNKNOWN))
+            el_t = args[0].type
+            for a in args[1:]:
+                el_t = common_super_type(el_t, a.type)
+            vals = []
+            for a in args:
+                a = _cast_ir(a, el_t)
+                if not isinstance(a, Const):
+                    raise PlanningError(
+                        "ARRAY[...] elements must be literals (runtime array "
+                        "construction is not supported on dict-coded lanes)"
+                    )
+                vals.append(a.value)
+            return Const(tuple(vals), ArrayType(el_t))
+        if name == "sequence":
+            if not all(isinstance(a, Const) for a in args):
+                raise PlanningError("sequence() bounds must be literals")
+            start, stop = int(args[0].value), int(args[1].value)
+            step = int(args[2].value) if len(args) > 2 else (1 if stop >= start else -1)
+            if step == 0:
+                raise PlanningError("sequence() step must not be zero")
+            rng = range(start, stop + (1 if step > 0 else -1), step)
+            if len(rng) > 1_000_000:  # O(1) length check BEFORE materializing
+                raise PlanningError("sequence() longer than 1000000")
+            return Const(tuple(rng), ArrayType(BIGINT))
+        if name == "split":
+            if args[0].type != VARCHAR:
+                raise PlanningError("split requires varchar")
+            return Call("split", args, ArrayType(VARCHAR))
+        if name == "cardinality":
+            if not args[0].type.is_array:
+                raise PlanningError("cardinality requires an array")
+            return Call("cardinality", args, BIGINT)
+        if name == "element_at":
+            if not args[0].type.is_array:
+                raise PlanningError("element_at requires an array")
+            return Call("element_at", args, args[0].type.element)
+        if name == "contains":
+            if not args[0].type.is_array:
+                raise PlanningError("contains requires an array")
+            return Call("contains", args, BOOLEAN)
+        if name == "array_position":
+            if not args[0].type.is_array:
+                raise PlanningError("array_position requires an array")
+            if not isinstance(args[1], Const):
+                raise PlanningError("array_position needle must be a literal")
+            return Call("array_position", args, BIGINT)
+        if name in ("array_distinct", "array_sort"):
+            if not args[0].type.is_array:
+                raise PlanningError(f"{name} requires an array")
+            return Call(name, args, args[0].type)
+        if name == "array_join":
+            if not args[0].type.is_array:
+                raise PlanningError("array_join requires an array")
+            return Call("array_join", args, VARCHAR)
+        if name in ("array_min", "array_max"):
+            if not args[0].type.is_array:
+                raise PlanningError(f"{name} requires an array")
+            return Call(name, args, args[0].type.element)
         raise PlanningError(f"unknown function: {name}")
 
 
@@ -1474,6 +1746,12 @@ def _cast_const(v, target: Type, source: Type = UNKNOWN):
         return float(v)
     if target.is_integer:
         return int(v)
+    if target == DATE and isinstance(v, str):
+        return date_to_days(v.strip())
+    if target == BOOLEAN and isinstance(v, str):
+        return {"true": True, "false": False}[v.strip().lower()]
+    if target == VARCHAR and not isinstance(v, str):
+        return str(v)
     return v
 
 

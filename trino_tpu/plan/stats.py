@@ -150,6 +150,14 @@ def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:
     if isinstance(node, RemoteSource):
         return PlanStats(_DEFAULT_ROWS, {})
 
+    from .nodes import Unnest
+
+    if isinstance(node, Unnest):
+        # average array cardinality is unknown without histogram stats; 3x is
+        # the conventional guess (capacity retries correct at runtime)
+        child = estimate(node.child, catalogs)
+        return PlanStats(max(1.0, child.rows * 3.0), child.columns)
+
     return PlanStats(_DEFAULT_ROWS, {})
 
 

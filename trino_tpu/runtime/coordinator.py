@@ -562,6 +562,11 @@ class Coordinator:
 def _statement_surface(coord: "Coordinator"):
     from .engine import Engine
 
+    # one persistent surface per coordinator: prepared statements and
+    # transaction snapshots must survive across statements (reference: the
+    # session holds prepared statements / the TransactionManager holds txns).
+    # Guarded by the coordinator lock: handler threads race on first use.
+
     class _StatementSurface(Engine):
         """The Engine statement executor with its two query primitives
         rebound to the multi-host scheduler: `query` runs the SELECT
@@ -581,6 +586,16 @@ def _statement_surface(coord: "Coordinator"):
 
             self.events = EventListenerManager()
             self._query_seq = 0
+            self._prepared = {}
+            self._tx_snapshots = None
+            from ..utils.tracing import Tracer
+            from .security import AllowAllAccessControl
+
+            self.access_control = getattr(
+                coord, "access_control", None
+            ) or AllowAllAccessControl()
+            self.user = "user"
+            self.tracer = Tracer()
 
         def plan(self, sql_or_query):
             return optimize(self.planner.plan(sql_or_query))
@@ -595,7 +610,10 @@ def _statement_surface(coord: "Coordinator"):
             types = list(plan.output_types)
             return list(plan.output_names), types, _rows_to_columns(rows, types)
 
-    return _StatementSurface()
+    with coord._lock:
+        if getattr(coord, "_stmt_surface", None) is None:
+            coord._stmt_surface = _StatementSurface()
+        return coord._stmt_surface
 
 
 def _rows_to_columns(rows: list[tuple], types: list):
@@ -669,6 +687,43 @@ def _make_handler(coord: Coordinator):
 
         def do_GET(self):
             parts = self.path.strip("/").split("/")
+            if self.path in ("/ui", "/ui/", "/"):
+                # minimal cluster/query dashboard (reference: core/trino-web-ui
+                # React app + server/ui/ClusterStatsResource; here one
+                # self-refreshing page over the same coordinator state)
+                import html as _html
+
+                with coord._lock:
+                    qrows = "".join(
+                        f"<tr><td>{_html.escape(str(qid))}</td>"
+                        f"<td>{_html.escape(rec['sm'].state)}</td>"
+                        f"<td><code>{_html.escape(str(rec.get('sql'))[:120])}</code></td></tr>"
+                        for qid, rec in list(coord.queries.items())[-50:]
+                    )
+                wrows = "".join(
+                    f"<tr><td>{_html.escape(w.url)}</td>"
+                    f"<td>{'alive' if w.alive else 'dead'}</td></tr>"
+                    for w in coord.workers.values()
+                )
+                body = (
+                    "<!doctype html><html><head><meta charset='utf-8'>"
+                    "<meta http-equiv='refresh' content='3'>"
+                    "<title>trino_tpu</title><style>body{font-family:monospace;"
+                    "margin:2em}table{border-collapse:collapse}td,th{border:1px "
+                    "solid #999;padding:4px 8px}</style></head><body>"
+                    "<h2>trino_tpu coordinator</h2>"
+                    f"<h3>workers ({len(coord.workers)})</h3>"
+                    f"<table><tr><th>url</th><th>state</th></tr>{wrows}</table>"
+                    f"<h3>queries ({len(coord.queries)})</h3>"
+                    "<table><tr><th>id</th><th>state</th><th>sql</th></tr>"
+                    f"{qrows}</table></body></html>"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if parts[:2] == ["v1", "info"]:
                 return self._send_json(
                     200,

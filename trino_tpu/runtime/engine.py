@@ -21,11 +21,39 @@ import numpy as np
 from ..connectors.spi import CatalogManager, ColumnSchema, Connector
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
-from ..plan.nodes import PlanNode, format_plan
+from ..plan.nodes import PlanNode, TableScan, format_plan
 from ..plan.planner import Planner
 from .session import SessionProperties
 
 __all__ = ["Engine"]
+
+
+def _rescale_column(arr, src_type, dst_type):
+    """Align a query-result column with the target table's column type.
+    Decimal lanes are scaled int64 (data/types.py DecimalType), so writing
+    them into a double/int/differently-scaled column must rescale — a plain
+    astype would persist the raw lanes (e.g. 1.5 stored as 15)."""
+    src_dec = getattr(src_type, "scale", None) if src_type.is_decimal else None
+    dst_dec = getattr(dst_type, "scale", None) if dst_type.is_decimal else None
+    if src_dec is None and dst_dec is None:
+        return arr
+    mask = np.ma.getmaskarray(arr) if isinstance(arr, np.ma.MaskedArray) else None
+    base = np.ma.getdata(arr) if mask is not None else np.asarray(arr)
+    if src_dec is not None and dst_dec is None:
+        out = (
+            base.astype(np.float64) / (10.0**src_dec)
+            if dst_type.is_floating
+            else np.round(base.astype(np.float64) / (10.0**src_dec)).astype(np.int64)
+        )
+    elif src_dec is None and dst_dec is not None:
+        out = np.round(base.astype(np.float64) * (10.0**dst_dec)).astype(np.int64)
+    elif src_dec != dst_dec:
+        out = np.round(base.astype(np.float64) * (10.0 ** (dst_dec - src_dec))).astype(
+            np.int64
+        )
+    else:
+        return arr
+    return np.ma.MaskedArray(out, mask=mask) if mask is not None else out
 
 
 class Engine:
@@ -54,6 +82,17 @@ class Engine:
 
         self.events = EventListenerManager()
         self._query_seq = 0
+        self._prepared: dict[str, str] = {}
+        self._tx_snapshots = None  # name -> connector snapshot, inside a tx
+        from .security import AllowAllAccessControl
+
+        # reference: security/AccessControlManager consulted before planning
+        self.access_control = AllowAllAccessControl()
+        self.user = "user"
+        from ..utils.tracing import Tracer
+
+        # reference: OpenTelemetry spans (SqlQueryExecution.java:473)
+        self.tracer = Tracer()
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -67,6 +106,16 @@ class Engine:
         from ..plan.optimizer import optimize
 
         plan = optimize(self.planner.plan(sql_or_query))
+        # table-level SELECT checks on the final plan: base tables of views/
+        # CTEs/subqueries are all visible as scans here (reference:
+        # checkCanSelectFromColumns per analyzed table reference)
+        from ..plan.nodes import walk
+
+        for n in walk(plan):
+            if isinstance(n, TableScan):
+                self.access_control.check_can_select(
+                    self.user, n.catalog, n.table, n.column_names
+                )
         if self.distributed:
             from ..plan.distribute import distribute
 
@@ -79,7 +128,12 @@ class Engine:
         return format_plan(self.plan(sql))
 
     def execute_page(self, sql) -> Page:
-        plan = self.plan(sql)
+        with self.tracer.span("planner"):
+            plan = self.plan(sql)
+        with self.tracer.span("execute"):
+            return self._execute_planned(plan)
+
+    def _execute_planned(self, plan) -> Page:
         budget = int(self.session.get("query_max_memory_bytes") or 0)
         if budget and not self.distributed:
             from ..exec.spill import OutOfCoreExecutor, estimate_plan_bytes
@@ -109,7 +163,9 @@ class Engine:
         self.events.fire(QueryEvent("created", qid, text))
         t0 = _time.perf_counter()
         try:
-            rows = self.execute_page(sql).to_pylist()
+            with self.tracer.span("query", query_id=qid):
+                rows = self.execute_page(sql).to_pylist()
+                self.tracer.annotate(rows=len(rows))
         except Exception as e:
             self.events.fire(
                 QueryEvent("failed", qid, text, _time.perf_counter() - t0, error=str(e))
@@ -143,6 +199,24 @@ class Engine:
 
     def execute_stmt(self, stmt) -> list[tuple]:
         from ..sql import statements as S
+
+        # access control at statement dispatch (reference: AccessControl
+        # checkCanInsertIntoTable / checkCanDropTable / ... before execution;
+        # SELECT is checked per-scan in plan())
+        if isinstance(stmt, (S.CreateTable, S.CreateTableAs)):
+            self._check_write(stmt.name, "create")
+        elif isinstance(stmt, (S.Insert, S.InsertValues)):
+            self._check_write(stmt.table, "insert")
+        elif isinstance(stmt, S.DropTable):
+            self._check_write(stmt.name, "drop")
+        elif isinstance(stmt, S.Delete):
+            self._check_write(stmt.table, "delete")
+        elif isinstance(stmt, S.Update):
+            self._check_write(stmt.table, "update")
+        elif isinstance(stmt, S.Merge):
+            self._check_write(stmt.target, "merge")
+        elif isinstance(stmt, S.SetSession):
+            self.access_control.check_can_set_session(self.user, stmt.name)
 
         if isinstance(stmt, S.QueryStmt):
             return self.query(stmt.query)
@@ -206,8 +280,23 @@ class Engine:
             return [(n,)]
 
         if isinstance(stmt, S.Insert):
-            _, _, cols = self._query_columns(stmt.query)
-            return [(self._insert(stmt.table, stmt.columns, cols),)]
+            _, types, cols = self._query_columns(stmt.query)
+            conn, table = self._target_conn(stmt.table)
+            schema = conn.table_schema(table)
+            names = (
+                list(stmt.columns)
+                if stmt.columns
+                else [c.name for c in schema.columns]
+            )
+            if len(names) != len(cols):
+                raise ValueError(
+                    f"INSERT column count mismatch: {len(names)} vs {len(cols)}"
+                )
+            cols = [
+                _rescale_column(arr, t, schema.type_of(n))
+                for arr, t, n in zip(cols, types, names)
+            ]
+            return [(self._insert_resolved(conn, table, names, cols),)]
 
         if isinstance(stmt, S.InsertValues):
             return [(self._insert_values(stmt),)]
@@ -232,27 +321,97 @@ class Engine:
             self.session.set(stmt.name, stmt.value)
             return [(1,)]
 
+        if isinstance(stmt, S.Delete):
+            from .dml import execute_delete
+
+            return [(execute_delete(self, stmt),)]
+
+        if isinstance(stmt, S.Update):
+            from .dml import execute_update
+
+            return [(execute_update(self, stmt),)]
+
+        if isinstance(stmt, S.Merge):
+            from .dml import execute_merge
+
+            return [(execute_merge(self, stmt),)]
+
+        if isinstance(stmt, S.Prepare):
+            self._prepared[stmt.name] = stmt.sql
+            return [(1,)]
+
+        if isinstance(stmt, S.ExecuteStmt):
+            if stmt.name not in self._prepared:
+                raise KeyError(f"prepared statement not found: {stmt.name}")
+            bound = S.parse_statement(
+                self._prepared[stmt.name], params=stmt.parameters
+            )
+            return self.execute_stmt(bound)
+
+        if isinstance(stmt, S.Deallocate):
+            self._prepared.pop(stmt.name, None)
+            return [(1,)]
+
+        if isinstance(stmt, S.StartTransaction):
+            # per-session transaction over writable catalogs: connectors that
+            # support snapshot/restore participate (reference:
+            # transaction/TransactionManager + connector tx handles; here the
+            # rewrite-and-swap write path makes copy-on-write snapshots cheap)
+            if self._tx_snapshots is not None:
+                raise RuntimeError("transaction already in progress")
+            self._tx_snapshots = {
+                name: self.catalogs.get(name).snapshot()
+                for name in self.catalogs.names()
+                if hasattr(self.catalogs.get(name), "snapshot")
+            }
+            return [(1,)]
+
+        if isinstance(stmt, S.Commit):
+            if self._tx_snapshots is None:
+                raise RuntimeError("no transaction in progress")
+            self._tx_snapshots = None
+            return [(1,)]
+
+        if isinstance(stmt, S.Rollback):
+            if self._tx_snapshots is None:
+                raise RuntimeError("no transaction in progress")
+            for name, snap in self._tx_snapshots.items():
+                self.catalogs.get(name).restore(snap)
+            self._tx_snapshots = None
+            return [(1,)]
+
         raise NotImplementedError(f"statement {type(stmt).__name__}")
 
     def _target_conn(self, name: str):
         """Resolve a possibly `catalog.table`-qualified DDL/DML target
         (Trino 2-part semantics: an unknown first part falls back to a plain
         table name in the default catalog)."""
+        conn, _catalog, table = self._target_ref(name)
+        return conn, table
+
+    def _check_write(self, name: str, operation: str) -> None:
+        _, catalog, table = self._target_ref(name)
+        self.access_control.check_can_write(self.user, catalog, table, operation)
+
+    def _target_ref(self, name: str):
+        """(connector, catalog name, table name) of a DDL/DML target."""
         if "." in name:
             parts = name.split(".")
             try:
-                # catalog.table or catalog.schema.table (schema is vestigial:
-                # connectors here are single-schema)
-                return self.catalogs.get(parts[0]), parts[-1]
+                return self.catalogs.get(parts[0]), parts[0], parts[-1]
             except KeyError:
                 pass
-        return self.catalogs.get(self.default_catalog), name
+        return self.catalogs.get(self.default_catalog), self.default_catalog, name
 
     # ------------------------------------------------------------ write path
     def _insert(self, table: str, columns, cols: list) -> int:
         conn, table = self._target_conn(table)
         schema = conn.table_schema(table)
         names = list(columns) if columns else [c.name for c in schema.columns]
+        return self._insert_resolved(conn, table, names, cols)
+
+    def _insert_resolved(self, conn, table: str, names: list, cols: list) -> int:
+        schema = conn.table_schema(table)
         if len(names) != len(cols):
             raise ValueError(f"INSERT column count mismatch: {len(names)} vs {len(cols)}")
         data = {}

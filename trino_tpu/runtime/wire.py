@@ -33,7 +33,19 @@ def _host_columns(page: Page) -> tuple[list[np.ndarray], list, np.ndarray]:
     datas, valids = [], []
     for col in page.columns:
         data = np.asarray(col.data)[idx]
-        if col.type.is_string:
+        if col.type.is_array:
+            # arrays cross the wire as JSON text (codes are process-local);
+            # wire_to_page re-encodes into the receiver's dictionary
+            import json as _json
+
+            if len(idx):
+                vals = col.dictionary.values[
+                    np.clip(data, 0, max(len(col.dictionary) - 1, 0))
+                ]
+                data = np.array([_json.dumps(list(v)) for v in vals], dtype=object)
+            else:
+                data = np.array([], dtype=object)
+        elif col.type.is_string:
             data = (
                 col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
                 if len(idx)
@@ -124,11 +136,12 @@ def wire_to_page(
         cap = 1 << max(0, (total - 1).bit_length())
     columns: list[Column] = []
     for i, t in enumerate(types):
+        wire_obj = t.is_string or t.is_array  # object lanes on the wire
         datas = [p[f"c{i:04d}"] for p in parts if f"c{i:04d}" in p]
         if datas:
             data = np.concatenate(datas)
         else:
-            data = np.empty((0,), dtype=object if t.is_string else t.np_dtype)
+            data = np.empty((0,), dtype=object if wire_obj else t.np_dtype)
         n = len(data)
         has_valid = any(f"v{i:04d}" in p for p in parts)
         valid = None
@@ -145,10 +158,21 @@ def wire_to_page(
             if valid is not None and len(data):
                 data = data.copy()
                 data[~valid] = ""
+        if t.is_array:
+            # JSON text -> tuples (Column.from_numpy dictionary-encodes)
+            import json as _json
+
+            decoded = np.empty(len(data), dtype=object)
+            for j, s in enumerate(data):
+                decoded[j] = tuple(_json.loads(s)) if isinstance(s, str) and s else ()
+            data = decoded
         if cap > n:
-            fill = np.zeros((cap - n,), dtype=object if t.is_string else t.np_dtype)
+            fill = np.zeros((cap - n,), dtype=object if wire_obj else t.np_dtype)
             if t.is_string:
                 fill[:] = ""
+            elif t.is_array:
+                for j in range(len(fill)):
+                    fill[j] = ()
             data = np.concatenate([data, fill])
             if valid is not None:
                 valid = np.concatenate([valid, np.zeros(cap - n, np.bool_)])

@@ -128,6 +128,7 @@ class CaseExpr(Expr):
 class Cast(Expr):
     operand: Expr
     type_name: str
+    try_: bool = False  # TRY_CAST: failures become NULL instead of errors
 
 
 @dataclass(frozen=True)
@@ -203,6 +204,18 @@ class Table(Relation):
 class SubqueryRelation(Relation):
     query: "Query"
     alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class UnnestRelation(Relation):
+    """UNNEST(a [, b...]) [WITH ORDINALITY] [AS alias (col, ...)] — lateral:
+    the array expressions may reference columns of preceding FROM items
+    (reference: sql/tree/Unnest + RelationPlanner.planJoinUnnest)."""
+
+    exprs: tuple[Expr, ...]
+    alias: Optional[str] = None
+    column_aliases: tuple[str, ...] = ()
+    with_ordinality: bool = False
 
 
 @dataclass(frozen=True)

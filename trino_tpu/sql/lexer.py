@@ -32,6 +32,7 @@ class Token:
 _OPERATORS = [
     "<>", "!=", ">=", "<=", "||", "->",
     "+", "-", "*", "/", "%", "(", ")", ",", ".", ";", "<", ">", "=", "?",
+    "[", "]",
 ]
 
 

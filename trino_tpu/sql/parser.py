@@ -45,6 +45,11 @@ class _Parser:
     def __init__(self, tokens: list[Token]):
         self.tokens = tokens
         self.i = 0
+        # prepared-statement parameters: None outside EXECUTE (a '?' is then a
+        # syntax error), "probe" during PREPARE validation ('?' -> NULL), or
+        # the ordered list of literal Exprs bound by EXECUTE ... USING
+        self.params = None
+        self.param_i = 0
 
     # ------------------------------------------------------------- utilities
     @property
@@ -331,6 +336,32 @@ class _Parser:
             rel = JoinRelation(kind, rel, right, on)
 
     def parse_relation_primary(self) -> Relation:
+        if self.peek_kw("UNNEST"):
+            from .ast import UnnestRelation
+
+            self.accept_kw("UNNEST")
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            with_ord = False
+            if self.accept_kw("WITH"):
+                self.expect_kw("ORDINALITY")
+                with_ord = True
+            alias = None
+            col_aliases: list[str] = []
+            if self.accept_kw("AS"):
+                alias = self.ident()
+            elif self.cur.kind in ("IDENT", "QIDENT") and not self._is_reserved():
+                alias = self.ident()
+            if alias is not None and self.accept_op("("):
+                while True:
+                    col_aliases.append(self.ident())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return UnnestRelation(tuple(exprs), alias, tuple(col_aliases), with_ord)
         if self.accept_op("("):
             if self.peek_kw("SELECT", "WITH"):
                 q = self.parse_query()
@@ -458,10 +489,31 @@ class _Parser:
             return Neg(self.parse_unary())
         if self.accept_op("+"):
             return self.parse_unary()
-        return self.parse_primary()
+        e = self.parse_primary()
+        # postfix subscript: a[i] == element_at(a, i) (SqlBase.g4 subscript)
+        while self.accept_op("["):
+            ix = self.parse_expr()
+            self.expect_op("]")
+            e = FuncCall("element_at", (e, ix))
+        return e
 
     def parse_primary(self) -> Expr:
         t = self.cur
+        if t.kind == "OP" and t.value == "?":
+            # prepared-statement parameter (reference: sql/tree/Parameter,
+            # bound by ExecuteStmt via statements.parse_statement(params=...))
+            self.i += 1
+            if self.params is None:
+                raise SqlSyntaxError(f"parameter '?' outside PREPARE/EXECUTE at {t.pos}")
+            if self.params == "probe":
+                return NullLit()
+            if self.param_i >= len(self.params):
+                raise SqlSyntaxError(
+                    f"too few parameters: statement needs more than {len(self.params)}"
+                )
+            e = self.params[self.param_i]
+            self.param_i += 1
+            return e
         if t.kind == "NUMBER":
             self.i += 1
             if "e" in t.value or "E" in t.value:
@@ -514,16 +566,26 @@ class _Parser:
                 unit = self.ident().lower()
                 unit = unit.rstrip("s") if unit.endswith("s") else unit
                 return IntervalLit(int(v.value), unit)
+            if kw == "ARRAY" and self.tokens[self.i + 1].kind == "OP" and self.tokens[self.i + 1].value == "[":
+                self.i += 2
+                items: list[Expr] = []
+                if not self.accept_op("]"):
+                    while True:
+                        items.append(self.parse_expr())
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op("]")
+                return FuncCall("array_constructor", tuple(items))
             if kw == "CASE":
                 return self.parse_case()
-            if kw == "CAST":
+            if kw in ("CAST", "TRY_CAST"):
                 self.i += 1
                 self.expect_op("(")
                 e = self.parse_expr()
                 self.expect_kw("AS")
                 type_name = self.parse_type_name()
                 self.expect_op(")")
-                return Cast(e, type_name)
+                return Cast(e, type_name, kw == "TRY_CAST")
             if kw == "EXISTS":
                 self.i += 1
                 self.expect_op("(")
@@ -614,24 +676,45 @@ class _Parser:
                     break
         if self.peek_kw("ROWS", "RANGE", "GROUPS"):
             unit = self.ident().lower()
-            # accept the common frames; semantics beyond the defaults:
-            # ROWS UNBOUNDED PRECEDING [AND CURRENT ROW] and the full-partition
-            # frame UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING
-            if self.accept_kw("BETWEEN"):
-                self.expect_kw("UNBOUNDED")
-                self.expect_kw("PRECEDING")
-                self.expect_kw("AND")
+
+            def bound(is_start: bool):
+                """-> 'u' (unbounded), or signed int offset (negative ==
+                PRECEDING, 0 == CURRENT ROW, positive == FOLLOWING)."""
                 if self.accept_kw("UNBOUNDED"):
-                    self.expect_kw("FOLLOWING")
-                    frame = "whole"
-                else:
-                    self.expect_kw("CURRENT")
+                    self.expect_kw("PRECEDING" if is_start else "FOLLOWING")
+                    return "u"
+                if self.accept_kw("CURRENT"):
                     self.expect_kw("ROW")
-                    frame = f"{unit}_unbounded"
+                    return 0
+                t = self.cur
+                if t.kind != "NUMBER":
+                    raise SqlSyntaxError(f"expected frame bound at {t.pos}")
+                k = int(t.value)
+                self.i += 1
+                if self.accept_kw("PRECEDING"):
+                    return -k
+                self.expect_kw("FOLLOWING")
+                return k
+
+            if self.accept_kw("BETWEEN"):
+                lo = bound(True)
+                self.expect_kw("AND")
+                hi = bound(False)
             else:
-                self.expect_kw("UNBOUNDED")
-                self.expect_kw("PRECEDING")
+                lo = bound(True)
+                hi = 0
+            if lo == "u" and hi == "u":
+                frame = "whole"
+            elif lo == "u" and hi == 0:
                 frame = f"{unit}_unbounded"
+            elif unit == "rows":
+                # general offset frame (reference: window/FrameInfo ROWS
+                # mode); encoded for the kernel's prefix-difference path
+                frame = f"rows:{lo}:{hi}"
+            else:
+                raise SqlSyntaxError(
+                    f"{unit.upper()} frames with numeric offsets are not supported"
+                )
         self.expect_op(")")
         return WindowFunc(
             fc.name, fc.args, tuple(partition_by), tuple(order_by), frame

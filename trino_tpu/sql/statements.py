@@ -13,14 +13,16 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from .ast import Expr, Query
+from .ast import Expr, Query, Relation
 from .lexer import SqlSyntaxError, tokenize
 from .parser import _Parser
 
 __all__ = [
     "Statement", "QueryStmt", "CreateTable", "CreateTableAs", "Insert",
     "DropTable", "Explain", "ShowTables", "DescribeTable", "SetSession",
-    "InsertValues", "parse_statement",
+    "InsertValues", "Delete", "Update", "Merge", "MergeClause",
+    "Prepare", "ExecuteStmt", "Deallocate",
+    "StartTransaction", "Commit", "Rollback", "parse_statement",
 ]
 
 
@@ -90,15 +92,98 @@ class SetSession(Statement):
     value: str
 
 
-def parse_statement(sql: str) -> Statement:
+@dataclass(frozen=True)
+class Delete(Statement):
+    """DELETE FROM t [WHERE pred] (reference: sql/tree/Delete + the
+    row-level MERGE machinery, operator/MergeWriterOperator; here lowered by
+    the engine to a keep-survivors rewrite over the same query machinery)."""
+
+    table: str
+    where: Optional[Expr]
+
+
+@dataclass(frozen=True)
+class Update(Statement):
+    """UPDATE t SET c = e, ... [WHERE pred] (reference: sql/tree/Update)."""
+
+    table: str
+    assignments: tuple[tuple[str, Expr], ...]
+    where: Optional[Expr]
+
+
+@dataclass(frozen=True)
+class MergeClause:
+    """One WHEN [NOT] MATCHED [AND cond] THEN action clause.
+
+    kind: 'update' | 'delete' | 'insert'
+    assignments: for update — (column, expr); for insert — (column, expr)
+    with columns resolved by the engine when the INSERT column list is empty.
+    """
+
+    matched: bool
+    condition: Optional[Expr]
+    kind: str
+    assignments: tuple[tuple[Optional[str], Expr], ...] = ()
+
+
+@dataclass(frozen=True)
+class Merge(Statement):
+    """MERGE INTO target [AS alias] USING source [AS alias] ON cond WHEN ...
+    (reference: sql/tree/Merge; planner/MergeWriterOperator pipeline)."""
+
+    target: str
+    target_alias: Optional[str]
+    source: "Relation"
+    on: Expr
+    clauses: tuple[MergeClause, ...]
+
+
+@dataclass(frozen=True)
+class Prepare(Statement):
+    """PREPARE name FROM statement (reference: sql/tree/Prepare; session-held
+    prepared statements, parameters bound at EXECUTE)."""
+
+    name: str
+    sql: str  # original statement text (re-parsed with params at EXECUTE)
+
+
+@dataclass(frozen=True)
+class ExecuteStmt(Statement):
+    name: str
+    parameters: tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Deallocate(Statement):
+    name: str
+
+
+@dataclass(frozen=True)
+class StartTransaction(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class Commit(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class Rollback(Statement):
+    pass
+
+
+def parse_statement(sql: str, params=None) -> Statement:
     p = _Parser(tokenize(sql))
-    stmt = _parse_statement(p)
+    if params is not None:
+        p.params = list(params)
+    stmt = _parse_statement(p, sql)
     p.accept_op(";")
     p.expect_eof()
     return stmt
 
 
-def _parse_statement(p: "_Parser") -> Statement:
+def _parse_statement(p: "_Parser", sql: str = "") -> Statement:
     if p.peek_kw("SELECT", "WITH"):
         return QueryStmt(p.parse_query())
 
@@ -181,6 +266,118 @@ def _parse_statement(p: "_Parser") -> Statement:
 
     if p.accept_kw("DESCRIBE") or p.accept_kw("DESC"):
         return DescribeTable(_table_name(p))
+
+    if p.accept_kw("DELETE"):
+        p.expect_kw("FROM")
+        name = _table_name(p)
+        where = p.parse_expr() if p.accept_kw("WHERE") else None
+        return Delete(name, where)
+
+    if p.accept_kw("UPDATE"):
+        name = _table_name(p)
+        p.expect_kw("SET")
+        assignments = []
+        while True:
+            col = p.ident()
+            p.expect_op("=")
+            assignments.append((col, p.parse_expr()))
+            if not p.accept_op(","):
+                break
+        where = p.parse_expr() if p.accept_kw("WHERE") else None
+        return Update(name, tuple(assignments), where)
+
+    if p.accept_kw("MERGE"):
+        p.expect_kw("INTO")
+        target = _table_name(p)
+        target_alias = p._optional_alias()
+        p.expect_kw("USING")
+        source = p.parse_relation_primary()
+        p.expect_kw("ON")
+        on = p.parse_expr()
+        clauses = []
+        while p.accept_kw("WHEN"):
+            matched = True
+            if p.accept_kw("NOT"):
+                matched = False
+            p.expect_kw("MATCHED")
+            condition = p.parse_expr() if p.accept_kw("AND") else None
+            p.expect_kw("THEN")
+            if p.accept_kw("UPDATE"):
+                p.expect_kw("SET")
+                assigns = []
+                while True:
+                    col = p.ident()
+                    p.expect_op("=")
+                    assigns.append((col, p.parse_expr()))
+                    if not p.accept_op(","):
+                        break
+                clauses.append(MergeClause(matched, condition, "update", tuple(assigns)))
+            elif p.accept_kw("DELETE"):
+                clauses.append(MergeClause(matched, condition, "delete"))
+            else:
+                p.expect_kw("INSERT")
+                cols: list[Optional[str]] = []
+                if p.accept_op("("):
+                    while True:
+                        cols.append(p.ident())
+                        if not p.accept_op(","):
+                            break
+                    p.expect_op(")")
+                p.expect_kw("VALUES")
+                p.expect_op("(")
+                vals = [p.parse_expr()]
+                while p.accept_op(","):
+                    vals.append(p.parse_expr())
+                p.expect_op(")")
+                names = cols if cols else [None] * len(vals)
+                if cols and len(cols) != len(vals):
+                    raise SqlSyntaxError("MERGE INSERT column/value count mismatch")
+                clauses.append(
+                    MergeClause(matched, condition, "insert", tuple(zip(names, vals)))
+                )
+        if not clauses:
+            raise SqlSyntaxError("MERGE requires at least one WHEN clause")
+        return Merge(target, target_alias, source, on, tuple(clauses))
+
+    if p.accept_kw("PREPARE"):
+        name = p.ident()
+        p.expect_kw("FROM")
+        # keep the raw statement text; parameters are bound by re-parsing at
+        # EXECUTE (the reference keeps the parsed Statement in the session and
+        # rewrites Parameter nodes — same effect)
+        body = sql[p.cur.pos :].rstrip().rstrip(";")
+        # validate it parses now (without parameter values)
+        probe = _Parser(tokenize(body))
+        probe.params = "probe"  # placeholder mode: '?' becomes NULL
+        _parse_statement(probe, body)
+        p.i = len(p.tokens) - 1  # body consumed (EOF)
+        return Prepare(name, body)
+
+    if p.accept_kw("EXECUTE"):
+        name = p.ident()
+        params = []
+        if p.accept_kw("USING"):
+            while True:
+                params.append(p.parse_expr())
+                if not p.accept_op(","):
+                    break
+        return ExecuteStmt(name, tuple(params))
+
+    if p.accept_kw("DEALLOCATE"):
+        p.accept_kw("PREPARE")
+        return Deallocate(p.ident())
+
+    if p.accept_kw("START"):
+        p.expect_kw("TRANSACTION")
+        return StartTransaction()
+    if p.accept_kw("BEGIN"):
+        return StartTransaction()
+    if p.accept_kw("COMMIT"):
+        p.accept_kw("WORK")
+        return Commit()
+    if p.accept_kw("ROLLBACK"):
+        p.accept_kw("WORK")
+        return Rollback()
 
     if p.accept_kw("SET"):
         p.expect_kw("SESSION")

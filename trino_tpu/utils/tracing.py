@@ -1,0 +1,114 @@
+"""Tracing spans (reference: OpenTelemetry threaded through the engine —
+74 files import io.opentelemetry; spans for planning
+(SqlQueryExecution.java:473 tracer.spanBuilder("planner")), fragmenting,
+per-task/per-split execution, keyed by tracing/TrinoAttributes.java:29-56).
+
+Zero-dependency equivalent: a Tracer produces nested Spans (thread-local
+context stack), records wall time + attributes, and hands finished root
+spans to exporters.  The engine opens query/plan/execute spans
+(runtime/engine.py); anything can add children via `tracer.span(...)`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+__all__ = ["Span", "Tracer", "InMemorySpanExporter"]
+
+
+@dataclass
+class Span:
+    name: str
+    attributes: dict = field(default_factory=dict)
+    start_s: float = 0.0
+    end_s: float = 0.0
+    children: list = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_s - self.start_s) * 1e3
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "attributes": dict(self.attributes),
+            "duration_ms": round(self.duration_ms, 3),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def find(self, name: str) -> Optional["Span"]:
+        if self.name == name:
+            return self
+        for c in self.children:
+            hit = c.find(name)
+            if hit is not None:
+                return hit
+        return None
+
+
+class _Ctx(threading.local):
+    def __init__(self):
+        self.stack: list[Span] = []
+
+
+class Tracer:
+    """`with tracer.span("planner", query_id=qid): ...` — nested spans build
+    a tree; when the outermost span closes it goes to every exporter."""
+
+    def __init__(self) -> None:
+        self._ctx = _Ctx()
+        self._exporters: list[Callable[[Span], None]] = []
+
+    def add_exporter(self, exporter: Callable[[Span], None]) -> None:
+        self._exporters.append(exporter)
+
+    def span(self, name: str, **attributes):
+        return _SpanCm(self, name, attributes)
+
+    def current(self) -> Optional[Span]:
+        return self._ctx.stack[-1] if self._ctx.stack else None
+
+    def annotate(self, **attributes) -> None:
+        cur = self.current()
+        if cur is not None:
+            cur.attributes.update(attributes)
+
+
+class _SpanCm:
+    def __init__(self, tracer: Tracer, name: str, attributes: dict):
+        self.tracer = tracer
+        self.span = Span(name, dict(attributes))
+
+    def __enter__(self) -> Span:
+        self.span.start_s = time.perf_counter()
+        stack = self.tracer._ctx.stack
+        if stack:
+            stack[-1].children.append(self.span)
+        stack.append(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.span.end_s = time.perf_counter()
+        if exc is not None:
+            self.span.attributes["error"] = repr(exc)
+        stack = self.tracer._ctx.stack
+        stack.pop()
+        if not stack:  # root closed: export the finished trace
+            for ex in self.tracer._exporters:
+                try:
+                    ex(self.span)
+                except Exception:
+                    pass
+
+
+class InMemorySpanExporter:
+    """Test/debug exporter (reference: TestingTelemetry span capture)."""
+
+    def __init__(self) -> None:
+        self.traces: list[Span] = []
+
+    def __call__(self, span: Span) -> None:
+        self.traces.append(span)
