@@ -28,6 +28,20 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import jax  # noqa: E402
+
+# Persistent compilation cache: XLA/Mosaic compiles over the TPU tunnel take
+# minutes and dominate time-to-first-number; cached compiles bring repeat
+# bench runs (each driver round) down to seconds of warmup.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 from tests.tpch_queries import QUERIES  # noqa: E402
 
 # columns each benchmark query touches (for effective-bandwidth accounting)
@@ -57,17 +71,34 @@ def _touched_bytes(names, sf) -> int:
 
 
 def _bench_query(eng, name, sf, runs):
-    import jax
-
     plan = eng.plan(QUERIES[name])
     eng.executor.execute(plan)  # warm: generation + upload + compile
     times = []
     for _ in range(runs):
         t0 = time.perf_counter()
-        page = eng.executor.execute(plan)
-        jax.block_until_ready(page.columns[0].data)
+        eng.executor.execute(plan)
+        # no extra block_until_ready: execute() fetches the packed overflow
+        # vector synchronously, and that host copy completes only after the
+        # WHOLE XLA program (it is an output of the same program) — an extra
+        # readiness check costs a full network round-trip on tunneled TPUs
         times.append(time.perf_counter() - t0)
     return sorted(times)[len(times) // 2]
+
+
+def _sync_rtt_ms() -> float:
+    """Round-trip latency of one tiny synchronous device interaction — the
+    per-query latency floor this environment imposes (tunneled TPU: every
+    dispatch/fetch is a network RTT).  Reported so wall-clock numbers can be
+    read as fixed-latency + marginal-throughput."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((8,))
+    np_ = __import__("numpy")
+    np_.asarray(x + 1)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        np_.asarray(x + 1)
+    return (time.perf_counter() - t0) / 3 * 1e3
 
 
 def main() -> None:
@@ -83,7 +114,8 @@ def main() -> None:
     li_rows = len(tpch_data("lineitem", sf)["l_quantity"])
 
     detail = {}
-    for name in qnames:
+
+    def bench_one(name):
         try:
             elapsed = _bench_query(eng, name, sf, runs)
             nbytes = _touched_bytes(_TOUCHED[name], sf)
@@ -100,11 +132,9 @@ def main() -> None:
         except Exception as e:  # keep the headline metric alive
             detail[name] = {"error": str(e)[:200]}
 
-    print(
-        json.dumps({"sf": sf, "device": _device_kind(), "queries": detail}),
-        file=sys.stderr,
-    )
-
+    # headline FIRST so a driver-side timeout after q01 still records it
+    if "q01" in qnames:
+        bench_one("q01")
     rows_per_sec = detail.get("q01", {}).get("rows_per_sec")
     # only pay for the sqlite baseline run when there is a number to compare
     baseline_rps = _sqlite_baseline(sf, li_rows) if rows_per_sec else None
@@ -120,7 +150,23 @@ def main() -> None:
                 # rows (no JVM in this image to run the Java reference)
                 "vs_baseline": round(rows_per_sec / baseline_rps, 2) if baseline_rps else None,
             }
-        )
+        ),
+        flush=True,
+    )
+
+    for name in qnames:
+        if name != "q01":
+            bench_one(name)
+    print(
+        json.dumps(
+            {
+                "sf": sf,
+                "device": _device_kind(),
+                "sync_rtt_ms": round(_sync_rtt_ms(), 1),
+                "queries": detail,
+            }
+        ),
+        file=sys.stderr,
     )
 
 

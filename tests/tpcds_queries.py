@@ -197,3 +197,68 @@ order by i_item_id
 limit 100
 """
 ORDERED["q37"] = True
+
+# Q94: unshipped-from-same-warehouse web orders with returns excluded —
+# the EXISTS + NOT EXISTS self-join shape (north-star config #4 class)
+QUERIES["q94"] = """
+select count(distinct ws_order_number) as order_count,
+  sum(ws_ext_ship_cost) as total_shipping_cost,
+  sum(ws_net_profit) as total_net_profit
+from web_sales ws1, date_dim, customer_address, web_site
+where d_date between date '1999-02-01' and date '1999-02-01' + interval '60' day
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk
+  and ws1.ws_web_site_sk = web_site_sk
+  and exists (select 1 from web_sales ws2
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  and not exists (select 1 from web_returns wr1
+                  where ws1.ws_order_number = wr1.wr_order_number)
+"""
+ORDERED["q94"] = True
+
+# Q95: same skeleton but the multi-warehouse order set rides a CTE consumed
+# by two IN subqueries — CTE self-join + repeated-CTE CSE
+QUERIES["q95"] = """
+with ws_wh as
+ (select ws1.ws_order_number as won
+    from web_sales ws1, web_sales ws2
+   where ws1.ws_order_number = ws2.ws_order_number
+     and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+select count(distinct ws_order_number) as order_count,
+  sum(ws_ext_ship_cost) as total_shipping_cost,
+  sum(ws_net_profit) as total_net_profit
+from web_sales ws1, date_dim, customer_address
+where d_date between date '1999-02-01' and date '1999-02-01' + interval '60' day
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk
+  and ws1.ws_order_number in (select won from ws_wh)
+  and ws1.ws_order_number in
+      (select wr_order_number from web_returns, ws_wh
+        where wr_order_number = won)
+"""
+ORDERED["q95"] = True
+
+# Q64-lite: the cross-channel CTE joined against itself across two years —
+# the structural core of Q64's cs1/cs2 pattern (full Q64's 20-way dimension
+# join reuses patterns covered elsewhere in this suite)
+QUERIES["q64lite"] = """
+with cross_sales as
+ (select i_item_sk as item_sk, d_year as syear,
+         sum(ss_ext_sales_price) as sale,
+         sum(ss_net_profit) as profit
+    from store_sales, date_dim, item
+   where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+     and exists (select 1 from store_returns
+                  where ss_ticket_number = sr_ticket_number
+                    and ss_item_sk = sr_item_sk)
+   group by i_item_sk, d_year)
+select cs1.item_sk, cs1.syear, cs1.sale, cs2.syear, cs2.sale
+from cross_sales cs1, cross_sales cs2
+where cs1.item_sk = cs2.item_sk
+  and cs1.syear = 1999 and cs2.syear = 2000
+  and cs2.sale > cs1.sale
+order by cs1.item_sk, cs1.sale
+limit 100
+"""
+ORDERED["q64lite"] = False

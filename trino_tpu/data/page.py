@@ -206,17 +206,28 @@ class Page:
     def select_columns(self, indices: Sequence[int]) -> "Page":
         return Page(tuple(self.columns[i] for i in indices), self.live)
 
+    def _fetch_host(self):
+        """(live, [(data, valid), ...]) pulled in ONE batched device->host
+        transfer — per-array np.asarray would pay one network round-trip per
+        column on a tunneled TPU."""
+        import jax
+
+        everything = jax.device_get(
+            [self.live_mask()] + [(c.data, c.valid) for c in self.columns]
+        )
+        return np.asarray(everything[0]), everything[1:]
+
     # -- host-side materialization (result sets, test assertions) -----------
     def to_pylist(self) -> list[tuple]:
         """Compact live rows to host as Python tuples (None for NULL)."""
-        live = np.asarray(self.live_mask())
+        live, host_cols = self._fetch_host()
         idx = np.nonzero(live)[0]
         cols: list[np.ndarray] = []
         valids: list[Optional[np.ndarray]] = []
         pys: list[Any] = []
-        for col in self.columns:
-            data = np.asarray(col.data)[idx]
-            valid = None if col.valid is None else np.asarray(col.valid)[idx]
+        for col, (hdata, hvalid) in zip(self.columns, host_cols):
+            data = np.asarray(hdata)[idx]
+            valid = None if hvalid is None else np.asarray(hvalid)[idx]
             if col.type.is_array:
                 vals = (
                     col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
@@ -261,27 +272,20 @@ class Page:
         isNull) so CREATE TABLE AS / INSERT...SELECT persist validity instead
         of the garbage lane values (the reference's Block keeps its isNull
         bitmap through the ConnectorPageSink write path)."""
-        live = np.asarray(self.live_mask())
+        live, host_cols = self._fetch_host()
         idx = np.nonzero(live)[0]
         out: list[np.ndarray] = []
-        for col in self.columns:
-            data = np.asarray(col.data)[idx]
-            if col.type.is_array:
+        for col, (hdata, hvalid) in zip(self.columns, host_cols):
+            data = np.asarray(hdata)[idx]
+            if col.type.is_array or col.type.is_string:
                 if len(idx):
                     data = col.dictionary.values[
                         np.clip(data, 0, max(len(col.dictionary) - 1, 0))
                     ]
                 else:
                     data = np.array([], dtype=object)
-            elif col.type.is_string:
-                if len(idx):
-                    data = col.dictionary.values[
-                        np.clip(data, 0, max(len(col.dictionary) - 1, 0))
-                    ]
-                else:
-                    data = np.array([], dtype=object)
-            if col.valid is not None:
-                invalid = ~np.asarray(col.valid)[idx]
+            if hvalid is not None:
+                invalid = ~np.asarray(hvalid)[idx]
                 if invalid.any():
                     data = np.ma.MaskedArray(data, mask=invalid)
             out.append(data)

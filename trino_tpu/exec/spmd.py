@@ -200,6 +200,23 @@ class SpmdExecutor(LocalExecutor):
                      tuple(sorted((k, p.capacity) for k, p in inputs.items())))
         if cache_key not in self._jit_cache:
             smapped = smap(step)
-            self._jit_cache[cache_key] = jax.jit(lambda pages: smapped(pages))
-        out_page, required = self._jit_cache[cache_key](inputs)
-        return out_page, jax.device_get(required)
+            # pack overflow counters into one vector (see LocalExecutor._run:
+            # per-scalar device_get RPCs dominate latency on tunneled TPUs)
+            holder: dict = {"keys": None}
+
+            def call(pages, _holder=holder):
+                out_page, req = smapped(pages)
+                keys = sorted(req, key=repr)
+                _holder["keys"] = keys
+                packed = (
+                    jnp.stack([jnp.asarray(req[k], jnp.int64) for k in keys])
+                    if keys
+                    else jnp.zeros((0,), jnp.int64)
+                )
+                return out_page, packed
+
+            self._jit_cache[cache_key] = (jax.jit(call), holder)
+        fn, holder = self._jit_cache[cache_key]
+        out_page, packed = fn(inputs)
+        vals = np.asarray(packed)
+        return out_page, dict(zip(holder["keys"], vals.tolist()))

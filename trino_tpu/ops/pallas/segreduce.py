@@ -48,10 +48,17 @@ __all__ = ["SegRed", "fused_segment_reduce", "pallas_segreduce_supported"]
 
 _CHUNK_S = 8  # sublanes per row-chunk
 _CHUNK_L = 128  # lanes per row-chunk
-_CHUNK = _CHUNK_S * _CHUNK_L  # 1024 rows per grid step
+_CHUNK = _CHUNK_S * _CHUNK_L  # 1024 rows per exactness unit (one dot)
+# Row-chunks processed per GRID STEP (inner unrolled loop).  Each 1024-row
+# dot keeps its f32-exact partial-sum envelope; batching 8 of them per step
+# amortizes the per-step grid overhead that dominated wall time on small
+# queries (a sequential 1170-step grid cost ~100us/step of pure dispatch).
+_STEP_CHUNKS = 8
+_STEP_ROWS = _CHUNK * _STEP_CHUNKS
 _GTILE = 512  # group-axis tile (lanes)
 _LIMB_BITS = 14  # 1024 rows * (2^14-1) < 2^24: chunk partials f32-exact
 _CARRY_EVERY = 32  # 32 * 2^24 < 2^31: int32 accumulators never overflow
+_CARRY_EVERY_STEPS = _CARRY_EVERY // _STEP_CHUNKS
 _MAX_GROUPS = 8192  # beyond this the n*G one-hot work loses to sorting
 
 _SUM_EXACT_MAX_F32 = float(1 << 24)  # ints this small sum exactly per chunk
@@ -151,14 +158,14 @@ def _make_kernel(
             if imx:
                 imxacc[:] = jnp.full_like(imxacc, _I32_MIN)
 
-        sg = seg_ref[:]  # [S, L] int32
-        fvt = jnp.transpose(f_ref[:], (1, 0, 2)) if af else None  # [S, af, L]
-        ivt = jnp.transpose(i_ref[:], (1, 0, 2)) if ai else None
+        sg_all = seg_ref[:]  # [S * STEP_CHUNKS, L] int32
+        fv_all = f_ref[:] if af else None  # [af, S * STEP_CHUNKS, L]
+        iv_all = i_ref[:] if ai else None
 
-        def mm_pass(ref, acc, k, mask, sl, reduce, sentinel):
+        def mm_pass(ref, acc, k, mask, sl, rows, reduce, sentinel):
             v = ref[:]
             for a in range(k):
-                big = jnp.where(mask, v[a][:, :, None], sentinel)
+                big = jnp.where(mask, v[a][rows][:, :, None], sentinel)
                 cur = reduce(big, axis=(0, 1)).reshape(1, gt)
                 merge = jnp.minimum if reduce is jnp.min else jnp.maximum
                 acc[a : a + 1, sl] = merge(acc[a : a + 1, sl], cur)
@@ -166,42 +173,49 @@ def _make_kernel(
         for t in range(n_tiles):
             base = t * gt
             iota = jax.lax.broadcasted_iota(jnp.int32, (_CHUNK_S, _CHUNK_L, gt), 2)
-            mask = sg[:, :, None] == (iota + base)
-            oh = mask.astype(jnp.float32)
             sl = slice(base, base + gt)
+            # each 1024-row sub-chunk keeps its own dot (f32-exact partial
+            # sums); batching them in one grid step amortizes step overhead
+            for sc in range(_STEP_CHUNKS):
+                rows = slice(sc * _CHUNK_S, (sc + 1) * _CHUNK_S)
+                sg = sg_all[rows]
+                mask = sg[:, :, None] == (iota + base)
+                oh = mask.astype(jnp.float32)
 
-            if af:
-                part = jax.lax.dot_general(
-                    fvt, oh, (((2,), (1,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32, precision=hi,
-                )  # [S, af, gt]
-                p = jnp.sum(part, axis=0)
-                # Neumaier TwoSum: a + p == s + e exactly
-                a = facc[0:af, sl]
-                s = a + p
-                e = jnp.where(jnp.abs(a) >= jnp.abs(p), (a - s) + p, (p - s) + a)
-                facc[0:af, sl] = s
-                ferr[0:af, sl] += e
+                if af:
+                    fvt = jnp.transpose(fv_all[:, rows], (1, 0, 2))  # [S, af, L]
+                    part = jax.lax.dot_general(
+                        fvt, oh, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32, precision=hi,
+                    )  # [S, af, gt]
+                    p = jnp.sum(part, axis=0)
+                    # Neumaier TwoSum: a + p == s + e exactly
+                    a = facc[0:af, sl]
+                    s = a + p
+                    e = jnp.where(jnp.abs(a) >= jnp.abs(p), (a - s) + p, (p - s) + a)
+                    facc[0:af, sl] = s
+                    ferr[0:af, sl] += e
 
-            if ai:
-                part = jax.lax.dot_general(
-                    ivt, oh, (((2,), (1,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32, precision=hi,
-                )
-                iacc[0:ai, sl] += jnp.sum(part, axis=0).astype(jnp.int32)
+                if ai:
+                    ivt = jnp.transpose(iv_all[:, rows], (1, 0, 2))
+                    part = jax.lax.dot_general(
+                        ivt, oh, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32, precision=hi,
+                    )
+                    iacc[0:ai, sl] += jnp.sum(part, axis=0).astype(jnp.int32)
 
-            if amn:
-                mm_pass(mn_ref, mnacc, amn, mask, sl, jnp.min, jnp.float32(jnp.inf))
-            if amx:
-                mm_pass(mx_ref, mxacc, amx, mask, sl, jnp.max, jnp.float32(-jnp.inf))
-            if imn:
-                mm_pass(imn_ref, imnacc, imn, mask, sl, jnp.min, _I32_MAX)
-            if imx:
-                mm_pass(imx_ref, imxacc, imx, mask, sl, jnp.max, _I32_MIN)
+                if amn:
+                    mm_pass(mn_ref, mnacc, amn, mask, sl, rows, jnp.min, jnp.float32(jnp.inf))
+                if amx:
+                    mm_pass(mx_ref, mxacc, amx, mask, sl, rows, jnp.max, jnp.float32(-jnp.inf))
+                if imn:
+                    mm_pass(imn_ref, imnacc, imn, mask, sl, rows, jnp.min, _I32_MAX)
+                if imx:
+                    mm_pass(imx_ref, imxacc, imx, mask, sl, rows, jnp.max, _I32_MIN)
 
         if carry_groups:
 
-            @pl.when((i & (_CARRY_EVERY - 1)) == (_CARRY_EVERY - 1))
+            @pl.when((i & (_CARRY_EVERY_STEPS - 1)) == (_CARRY_EVERY_STEPS - 1))
             def _carry():
                 for (start, nl) in carry_groups:
                     for l in range(nl - 1):
@@ -230,12 +244,13 @@ def _make_kernel(
                 oimx_ref[:] = imxacc[0:imx, :]
 
     vmem = pltpu.VMEM
-    in_specs = [pl.BlockSpec((_CHUNK_S, _CHUNK_L), lambda i: (i, 0), memory_space=vmem)]
+    step_s = _CHUNK_S * _STEP_CHUNKS
+    in_specs = [pl.BlockSpec((step_s, _CHUNK_L), lambda i: (i, 0), memory_space=vmem)]
     out_specs, out_shape, scratch = [], [], []
     for k in counts:
         if k:
             in_specs.append(
-                pl.BlockSpec((k, _CHUNK_S, _CHUNK_L), lambda i: (0, i, 0), memory_space=vmem)
+                pl.BlockSpec((k, step_s, _CHUNK_L), lambda i: (0, i, 0), memory_space=vmem)
             )
     out_cfg = (
         (2 * af, jnp.float32),
@@ -309,8 +324,8 @@ def fused_segment_reduce(
         return _xla_fallback(seg, reds, G)
 
     g_pad = max(_GTILE, -(-(G + 1) // _GTILE) * _GTILE)
-    n_pad = -(-n // _CHUNK) * _CHUNK
-    n_chunks = n_pad // _CHUNK
+    n_pad = -(-n // _STEP_ROWS) * _STEP_ROWS
+    n_chunks = n_pad // _STEP_ROWS  # grid steps (each = _STEP_CHUNKS dots)
 
     seg_c = jnp.clip(seg.astype(jnp.int32), 0, g_pad - 1)
     seg_c = jnp.where(seg.astype(jnp.int32) >= G, g_pad - 1, seg_c)

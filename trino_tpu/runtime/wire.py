@@ -28,11 +28,19 @@ CHUNK_ROWS = 262_144
 
 
 def _host_columns(page: Page) -> tuple[list[np.ndarray], list, np.ndarray]:
-    live = np.asarray(page.live_mask())
+    import jax
+
+    # one batched device->host transfer (tunneled TPUs pay a network
+    # round-trip per array otherwise; see data/page.py _fetch_host)
+    fetched = jax.device_get(
+        [page.live_mask()] + [(c.data, c.valid) for c in page.columns]
+    )
+    live = np.asarray(fetched[0])
+    host = fetched[1:]
     idx = np.nonzero(live)[0]
     datas, valids = [], []
-    for col in page.columns:
-        data = np.asarray(col.data)[idx]
+    for col, (hdata, hvalid) in zip(page.columns, host):
+        data = np.asarray(hdata)[idx]
         if col.type.is_array:
             # arrays cross the wire as JSON text (codes are process-local);
             # wire_to_page re-encodes into the receiver's dictionary
@@ -52,7 +60,7 @@ def _host_columns(page: Page) -> tuple[list[np.ndarray], list, np.ndarray]:
                 else np.array([], dtype=object)
             )
         datas.append(data)
-        valids.append(None if col.valid is None else np.asarray(col.valid)[idx])
+        valids.append(None if hvalid is None else np.asarray(hvalid)[idx])
     return datas, valids, idx
 
 
