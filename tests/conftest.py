@@ -44,6 +44,51 @@ except Exception:
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------- CI tiers
+# Two tiers (reference: fast PR checks vs nightly product tests,
+# testing/trino-product-tests/):
+#   smoke — `pytest -m smoke`, < 5 min on 1 CPU: data plane, Pallas
+#           interpreter kernels, a few TPC-H locals, ONE 8-device
+#           distributed query, multihost control-plane basics.
+#   full  — everything (the default; what the driver runs).
+_SMOKE = {
+    "tests/test_data_plane.py": None,  # None = whole module
+    "tests/test_native_serde.py": None,
+    "tests/test_pallas.py": None,
+    "tests/test_tpch.py": {"q01", "q06", "q03"},
+    "tests/test_tpch_distributed.py": {"q01"},
+    "tests/test_multihost.py": {
+        "test_client_protocol",
+        "test_discovery_and_heartbeat",
+        "test_task_level_retry",
+    },
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast CI tier (< 5 min on 1 CPU); run with -m smoke"
+    )
+    config.addinivalue_line(
+        "markers", "tpu: requires real TPU hardware (skipped on CPU-only hosts)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        rel = os.path.relpath(str(item.fspath), os.path.dirname(os.path.dirname(__file__)))
+        sel = _SMOKE.get(rel)
+        if sel is None and rel not in _SMOKE:
+            continue
+        if sel is None:
+            item.add_marker(pytest.mark.smoke)
+        else:
+            name = item.name
+            base = name.split("[")[0]
+            param = name[len(base) + 1 : -1] if "[" in name else None
+            if base in sel or (param is not None and param in sel):
+                item.add_marker(pytest.mark.smoke)
+
 
 @pytest.fixture(scope="session")
 def tpch_tiny():
