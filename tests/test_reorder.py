@@ -1,0 +1,122 @@
+"""Join-reordering pass (plan/reorder.py): plan change, correctness, and the
+capacity (peak intermediate size) win.
+
+Reference behavior being matched: iterative/rule/ReorderJoins.java — the
+optimizer rewrites a syntactically bad join order into the cost-optimal one
+using stats, without changing results.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import ColumnSchema
+from trino_tpu.data.types import BIGINT, VARCHAR
+from trino_tpu.plan.nodes import Join, TableScan, walk
+from trino_tpu.runtime.engine import Engine
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def engine():
+    rng = np.random.default_rng(7)
+    conn = MemoryConnector()
+    n_fact, n_dim, n_tiny = 20_000, 5_000, 20
+    conn.create_table(
+        "fact",
+        [ColumnSchema("f_id", BIGINT), ColumnSchema("f_dim", BIGINT),
+         ColumnSchema("f_tiny", BIGINT), ColumnSchema("f_val", BIGINT)],
+    )
+    conn.insert("fact", {
+        "f_id": np.arange(n_fact, dtype=np.int64),
+        "f_dim": rng.integers(0, n_dim, n_fact).astype(np.int64),
+        "f_tiny": rng.integers(0, n_tiny, n_fact).astype(np.int64),
+        "f_val": rng.integers(0, 1000, n_fact).astype(np.int64),
+    })
+    conn.create_table(
+        "dim", [ColumnSchema("d_id", BIGINT), ColumnSchema("d_name", VARCHAR)]
+    )
+    conn.insert("dim", {
+        "d_id": np.arange(n_dim, dtype=np.int64),
+        "d_name": np.asarray([f"d{i}" for i in range(n_dim)], dtype=object),
+    })
+    conn.create_table(
+        "tiny", [ColumnSchema("t_id", BIGINT), ColumnSchema("t_name", VARCHAR)]
+    )
+    conn.insert("tiny", {
+        "t_id": np.arange(n_tiny, dtype=np.int64),
+        "t_name": np.asarray([f"t{i}" for i in range(n_tiny)], dtype=object),
+    })
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", conn)
+    return eng
+
+
+# written worst-first: dim (biggest non-fact) joins first, the selective
+# tiny-with-filter join last
+_SQL = """
+SELECT t_name, count(*) AS c, sum(f_val) AS s
+FROM fact
+JOIN dim ON f_dim = d_id
+JOIN tiny ON f_tiny = t_id
+WHERE t_id < 2
+GROUP BY t_name
+ORDER BY t_name
+"""
+
+
+def _join_leaf_order(plan):
+    """Table names in scan (pre-)order — the executed join order."""
+    return [n.table for n in walk(plan) if isinstance(n, TableScan)]
+
+
+def test_reorder_changes_plan(engine):
+    from trino_tpu.plan.optimizer import optimize
+
+    baseline = optimize(engine.planner.plan(_SQL))  # no catalogs: no reorder
+    reordered = optimize(engine.planner.plan(_SQL), engine.catalogs)
+    assert _join_leaf_order(baseline) == ["fact", "dim", "tiny"]
+    # the filtered tiny relation (sel 2/20 -> ~2k rows out) must join before
+    # the 5k-row dim relation
+    order = _join_leaf_order(reordered)
+    assert order.index("tiny") < order.index("dim"), order
+
+
+def test_reorder_correctness(engine):
+    rows = engine.query(_SQL)
+    # recompute expected with numpy over the raw columns
+    conn = engine.catalogs.get("mem")
+    f = conn._data["fact"]
+    keep = f["f_tiny"] < 2
+    expected = []
+    for t in (0, 1):
+        m = keep & (f["f_tiny"] == t)
+        expected.append((f"t{t}", int(m.sum()), int(f["f_val"][m].sum())))
+    assert rows == expected
+
+
+def test_reorder_shrinks_intermediates(engine):
+    """The measured win: rows actually flowing through the join operators
+    drop when the selective join runs first (per-operator row counts from
+    the EXPLAIN ANALYZE machinery — real executed work, not estimates)."""
+    from trino_tpu.exec.compiler import LocalExecutor, _node_ids
+    from trino_tpu.plan.optimizer import optimize
+
+    def join_rows_executed(plan):
+        ex = LocalExecutor(engine.catalogs, "mem")
+        _, stats = ex.explain_analyze(plan)
+        nodes = _node_ids(plan)
+        return sum(
+            s["rows"]
+            for nid, s in stats.items()
+            if "rows" in s and isinstance(nodes[nid], Join)
+        )
+
+    baseline = optimize(engine.planner.plan(_SQL))  # pushdown, no reorder
+    reordered = optimize(engine.planner.plan(_SQL), engine.catalogs)
+    rows_base = join_rows_executed(baseline)
+    rows_reord = join_rows_executed(reordered)
+    # bad order: fact x dim joins all 20k rows first; good order: the
+    # t_id < 2 filter cuts the spine to ~2k before dim ever joins
+    assert rows_reord < rows_base / 2, (rows_reord, rows_base)

@@ -102,6 +102,22 @@ class MemoryConnector(Connector):
             return 0
         return len(next(iter(data.values())))
 
+    def table_stats(self, table: str):
+        """NDV/min-max column stats for the cost-based optimizer (reference:
+        MemoryMetadata.getTableStatistics); computed lazily, cached per write
+        generation."""
+        data = self._data.get(table)
+        if data is None:
+            return None
+        if not hasattr(self, "_stats_cache"):
+            self._stats_cache = {}
+        cached = self._stats_cache.get(table)
+        if cached is None or cached[0] != self.generation:
+            from .spi import compute_table_stats
+
+            self._stats_cache[table] = (self.generation, compute_table_stats(data))
+        return self._stats_cache[table][1]
+
 
 class BlackholeConnector(Connector):
     """Accepts any write, returns empty scans — sink for write benchmarks."""

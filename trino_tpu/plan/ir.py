@@ -13,7 +13,7 @@ an IR expression evaluates over a Page to (data: jnp.ndarray, valid: mask).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence
 
 from ..data.types import BOOLEAN, Type
 
@@ -113,6 +113,26 @@ def _collect(e: IrExpr, out: set[int]) -> None:
             _collect(e.default, out)
     elif isinstance(e, (InListIr, LikeIr)):
         _collect(e.operand, out)
+
+
+def substitute(e: IrExpr, exprs: Sequence["IrExpr"]) -> IrExpr:
+    """Replace each FieldRef i with exprs[i] — moves a predicate through a
+    Project (all IR expressions are pure, so duplication is safe)."""
+    if isinstance(e, FieldRef):
+        return exprs[e.index]
+    if isinstance(e, Call):
+        return Call(e.op, tuple(substitute(a, exprs) for a in e.args), e.type)
+    if isinstance(e, CaseWhen):
+        return CaseWhen(
+            tuple((substitute(c, exprs), substitute(r, exprs)) for c, r in e.whens),
+            None if e.default is None else substitute(e.default, exprs),
+            e.type,
+        )
+    if isinstance(e, InListIr):
+        return InListIr(substitute(e.operand, exprs), e.values, e.negated, e.type)
+    if isinstance(e, LikeIr):
+        return LikeIr(substitute(e.operand, exprs), e.pattern, e.negated, e.type)
+    return e
 
 
 def remap(e: IrExpr, mapping: dict[int, int]) -> IrExpr:

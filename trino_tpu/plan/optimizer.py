@@ -10,10 +10,15 @@ rewrites.  Current passes:
   (reference: PruneUnreferencedOutputs / PruneTableScanColumns rules).
   Matters doubly on TPU: narrower pages mean fewer HBM-resident arrays
   gathered through every join.
+- reorder_joins (plan/reorder.py): Selinger-style cost-based join order
+  over connected inner-equi-join regions (reference: ReorderJoins.java,
+  EliminateCrossJoins.java); needs catalogs for stats, so it only runs
+  when the caller passes them.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 from .ir import FieldRef, IrExpr, field_refs, remap
@@ -26,9 +31,93 @@ from .nodes import (
 __all__ = ["optimize", "prune_columns"]
 
 
-def optimize(plan: PlanNode) -> PlanNode:
+def optimize(plan: PlanNode, catalogs=None) -> PlanNode:
+    # push filters first: reorder's cost model reads relation stats AFTER
+    # their local predicates (a filter stuck above the join region would make
+    # every order look cost-equal)
+    plan = push_filters(plan)
+    if catalogs is not None:
+        from .reorder import reorder_joins
+
+        plan = reorder_joins(plan, catalogs)
+    # prune AFTER reordering: the restoring projections reorder_joins leaves
+    # behind get folded into the scans here
     plan = prune_columns(plan)
     return plan
+
+
+def push_filters(plan: PlanNode) -> PlanNode:
+    """Predicate pushdown as a whole-plan pass (reference:
+    PredicatePushDown.java / PushPredicateThroughProjectIntoRowNumber etc.):
+    WHERE conjuncts written over explicit JOIN ... ON trees sink to the
+    smallest subtree covering their column references.  The planner pushes
+    single-relation predicates for comma-joins at plan time; this pass covers
+    the explicit-join and post-planning shapes."""
+    from .ir import Call, substitute
+
+    def conjuncts_of(e: IrExpr) -> list[IrExpr]:
+        if isinstance(e, Call) and e.op == "and":
+            return conjuncts_of(e.args[0]) + conjuncts_of(e.args[1])
+        return [e]
+
+    def wrap(node: PlanNode, preds: list[IrExpr]) -> PlanNode:
+        for p in preds:
+            node = Filter(node, p)
+        return node
+
+    def push(node: PlanNode, preds: list[IrExpr]) -> PlanNode:
+        if isinstance(node, Filter):
+            return push(node.child, preds + conjuncts_of(node.predicate))
+
+        if isinstance(node, Project):
+            below = [substitute(p, node.expressions) for p in preds]
+            return Project(push(node.child, below), node.expressions, node.names)
+
+        if isinstance(node, Join):
+            nl = len(node.left.output_types)
+            lp: list[IrExpr] = []
+            rp: list[IrExpr] = []
+            keep: list[IrExpr] = []
+            for p in preds:
+                refs = field_refs(p)
+                if node.kind in ("inner", "semi", "anti", "null_anti", "cross"):
+                    # semi/anti output IS the left schema; filtering left rows
+                    # commutes with the (anti-)membership test
+                    if all(i < nl for i in refs):
+                        lp.append(p)
+                    elif node.kind == "inner" and refs and all(i >= nl for i in refs):
+                        rp.append(remap(p, {i: i - nl for i in refs}))
+                    else:
+                        keep.append(p)
+                elif node.kind == "left":
+                    # left-side predicates commute with null-extension;
+                    # right-side ones do NOT (they'd drop extended rows)
+                    if all(i < nl for i in refs):
+                        lp.append(p)
+                    else:
+                        keep.append(p)
+                else:
+                    keep.append(p)
+            new = dataclasses.replace(
+                node, left=push(node.left, lp), right=push(node.right, rp)
+            )
+            return wrap(new, keep)
+
+        # leaves / barriers (Aggregate: grouping-sets NULL-ed keys make key
+        # pushdown unsound in general; Limit/TopN/Window change row sets):
+        # recurse for nested filters, keep preds here
+        if isinstance(node, (Sort, Distinct)):
+            # filtering commutes with ordering and with duplicate elimination
+            return dataclasses.replace(node, child=push(node.child, preds))
+        children = tuple(push(c, []) for c in node.children)
+        if children:
+            if isinstance(node, Concat):
+                node = dataclasses.replace(node, inputs=children)
+            else:
+                node = dataclasses.replace(node, child=children[0])
+        return wrap(node, preds)
+
+    return push(plan, [])
 
 
 def prune_columns(plan: PlanNode) -> PlanNode:

@@ -1,0 +1,289 @@
+"""Stats-driven join reordering.
+
+The reference reorders joins inside the memo optimizer
+(sql/planner/iterative/rule/ReorderJoins.java, EliminateCrossJoins.java),
+costing orders with JoinStatsRule estimates.  Here the same decision runs as
+a whole-plan pass (beside prune_columns): flatten each maximal inner-equi-
+join region into a join graph over its leaf relations, cost candidate
+left-deep orders with the Selinger formula over plan/stats.py NDVs
+(rows(S join r) = rows(S) * rows(r) / prod over connecting edges of
+max(ndv_l, ndv_r)), pick the cheapest by total intermediate rows — exact
+subset DP for small regions, greedy for wide ones — and rebuild the region
+left-deep with a restoring projection on top.
+
+Only inner joins reorder (outer/semi join order is semantics-bearing), and
+only along connected edges (a reorder never introduces a cross product the
+author didn't write).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..connectors.spi import CatalogManager
+from ..data.types import BOOLEAN
+from .ir import Call, FieldRef, IrExpr, field_refs, remap
+from .nodes import Filter, Join, PlanNode, Project
+from .stats import estimate, _expr_ndv
+
+__all__ = ["reorder_joins"]
+
+# exact subset DP up to this many relations; greedy beyond (2^10 subsets is
+# still instant, and TPC-DS Q64's region is 8-way)
+_DP_LIMIT = 10
+
+
+def reorder_joins(plan: PlanNode, catalogs: CatalogManager) -> PlanNode:
+    def rw(node: PlanNode) -> PlanNode:
+        if _is_region_root(node):
+            return _reorder_region(node, rw, catalogs)
+        return _with_children(node, tuple(rw(c) for c in node.children))
+    return rw(plan)
+
+
+def _is_reorderable(node: PlanNode) -> bool:
+    return isinstance(node, Join) and node.kind == "inner" and bool(node.left_keys)
+
+
+def _is_region_root(node: PlanNode) -> bool:
+    # a region is worth reordering only when it spans >= 3 relations (the
+    # 2-way build/probe side choice belongs to plan/distribute.py)
+    if not _is_reorderable(node):
+        return False
+    return _count_rels(node) >= 3
+
+
+def _count_rels(node: PlanNode) -> int:
+    if _is_reorderable(node):
+        return _count_rels(node.left) + _count_rels(node.right)
+    return 1
+
+
+def _with_children(node: PlanNode, children: tuple[PlanNode, ...]) -> PlanNode:
+    if not children:
+        return node
+    if isinstance(node, Join):
+        return dataclasses.replace(node, left=children[0], right=children[1])
+    from .nodes import Concat
+
+    if isinstance(node, Concat):
+        return dataclasses.replace(node, inputs=children)
+    return dataclasses.replace(node, child=children[0])
+
+
+def _shift(e: IrExpr, off: int) -> IrExpr:
+    if off == 0:
+        return e
+    return remap(e, {i: i + off for i in field_refs(e)})
+
+
+def _reorder_region(root: Join, rw, catalogs: CatalogManager) -> PlanNode:
+    # ---- flatten: relations in original left-to-right order + conditions in
+    # region-global indices (the region's output schema IS the concatenation
+    # of its relations' outputs, so child-local key indices shift by the
+    # left subtree's width)
+    rels: list[PlanNode] = []
+    conds: list[tuple[IrExpr, IrExpr]] = []  # equi pairs, global indices
+    resids: list[IrExpr] = []  # non-equi / multi-rel predicates, global
+
+    def flatten(node: PlanNode, base: int) -> int:
+        """Returns the node's output width; appends leaf relations.  `base` is
+        the node's starting index in the region-global schema (the subtree's
+        child-local key indices shift by it)."""
+        if _is_reorderable(node):
+            lw = flatten(node.left, base)
+            rw_ = flatten(node.right, base + lw)
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                conds.append((_shift(lk, base), _shift(rk, base + lw)))
+            if node.residual is not None:
+                # residual is over (left ++ right) = this subtree's span
+                resids.append(_shift(node.residual, base))
+            return lw + rw_
+        rels.append(rw(node))  # recurse into the relation for nested regions
+        return len(node.output_types)
+
+    total_w = flatten(root, 0)
+    n = len(rels)
+    offsets: list[int] = []
+    off = 0
+    for r in rels:
+        offsets.append(off)
+        off += len(r.output_types)
+
+    def rel_of(idx: int) -> int:
+        for i in range(n - 1, -1, -1):
+            if idx >= offsets[i]:
+                return i
+        return 0
+
+    # ---- classify conditions into graph edges vs residual predicates
+    # edge: (rel_a, rel_b, expr_a_global, expr_b_global)
+    edges: list[tuple[int, int, IrExpr, IrExpr]] = []
+    for a, b in conds:
+        ra = {rel_of(i) for i in field_refs(a)}
+        rb = {rel_of(i) for i in field_refs(b)}
+        if len(ra) == 1 and len(rb) == 1 and ra != rb:
+            edges.append((ra.pop(), rb.pop(), a, b))
+        else:
+            # a key pair spanning >2 relations can't be a graph edge; keep it
+            # as an equality residual (NULL keys drop either way)
+            resids.append(Call("eq", (a, b), BOOLEAN))
+
+    if not edges:
+        return _rebuild_original(root, rw)
+
+    # ---- per-relation stats (filters are already pushed into relations)
+    rel_stats = [estimate(r, catalogs) for r in rels]
+    rel_rows = [max(1.0, s.rows) for s in rel_stats]
+
+    def to_local(e: IrExpr, r: int) -> IrExpr:
+        return remap(e, {i: i - offsets[r] for i in field_refs(e)})
+
+    def edge_ndv(eidx: int) -> float:
+        ra, rb, ea, eb = edges[eidx]
+        nda = _expr_ndv(to_local(ea, ra), rel_stats[ra])
+        ndb = _expr_ndv(to_local(eb, rb), rel_stats[rb])
+        known = [v for v in (nda, ndb) if v]
+        if known:
+            return max(known)
+        # FK->PK default: assume the join collapses to the larger side
+        return min(rel_rows[ra], rel_rows[rb])
+
+    ndvs = [max(1.0, edge_ndv(i)) for i in range(len(edges))]
+    adj: dict[int, list[int]] = {i: [] for i in range(n)}
+    for ei, (ra, rb, _, _) in enumerate(edges):
+        adj[ra].append(ei)
+        adj[rb].append(ei)
+
+    def join_rows(rows_s: float, members: frozenset, r: int) -> Optional[float]:
+        sel = 1.0
+        connected = False
+        for ei in adj[r]:
+            ra, rb, _, _ = edges[ei]
+            other = rb if ra == r else ra
+            if other in members:
+                connected = True
+                sel /= ndvs[ei]
+        if not connected:
+            return None
+        return max(1.0, rows_s * rel_rows[r] * sel)
+
+    order = (
+        _dp_order(n, rel_rows, join_rows)
+        if n <= _DP_LIMIT
+        else _greedy_order(n, rel_rows, join_rows, edges, ndvs)
+    )
+    if order is None or order == list(range(n)):
+        return _rebuild_original(root, rw)
+
+    # ---- rebuild left-deep in the chosen order
+    acc = rels[order[0]]
+    acc_rels = [order[0]]
+    applied = [False] * len(resids)
+
+    def acc_index(i: int) -> int:
+        """Global index -> index in the accumulated (reordered) schema."""
+        r = rel_of(i)
+        a_off = 0
+        for ar in acc_rels:
+            if ar == r:
+                break
+            a_off += len(rels[ar].output_types)
+        return a_off + (i - offsets[r])
+
+    def global_to_acc(e: IrExpr) -> IrExpr:
+        return remap(e, {i: acc_index(i) for i in field_refs(e)})
+
+    for r in order[1:]:
+        lkeys, rkeys = [], []
+        for ei in adj[r]:
+            ra, rb, ea, eb = edges[ei]
+            other, e_other, e_r = (rb, eb, ea) if ra == r else (ra, ea, eb)
+            if other in acc_rels:
+                lkeys.append(global_to_acc(e_other))
+                rkeys.append(to_local(e_r, r))
+        acc = Join("inner", acc, rels[r], tuple(lkeys), tuple(rkeys))
+        acc_rels.append(r)
+        # residuals fire at the first point all their relations are joined
+        have = set(acc_rels)
+        for i, pred in enumerate(resids):
+            if not applied[i] and {rel_of(j) for j in field_refs(pred)} <= have:
+                acc = Filter(acc, global_to_acc(pred))
+                applied[i] = True
+
+    # restore the region's original column order (and schema) on top
+    out_exprs = tuple(
+        FieldRef(acc_index(i), root.output_types[i]) for i in range(total_w)
+    )
+    return Project(acc, out_exprs, tuple(root.output_names))
+
+
+def _rebuild_original(root: Join, rw) -> PlanNode:
+    """Keep the syntactic order but still recurse into the relations."""
+    def rb(node: PlanNode) -> PlanNode:
+        if _is_reorderable(node):
+            return dataclasses.replace(node, left=rb(node.left), right=rb(node.right))
+        return rw(node)
+    return rb(root)
+
+
+def _dp_order(n, rel_rows, join_rows) -> Optional[list[int]]:
+    """Exact left-deep DP over connected subsets: dp[S] = (cost, rows, order)
+    with cost = sum of intermediate result sizes (ReorderJoins' cost-compare
+    in miniature)."""
+    dp: dict[frozenset, tuple[float, float, list[int]]] = {}
+    for i in range(n):
+        dp[frozenset([i])] = (0.0, rel_rows[i], [i])
+    for _size in range(2, n + 1):
+        new: dict[frozenset, tuple[float, float, list[int]]] = {}
+        for s, (cost, rows, order) in dp.items():
+            if len(s) != _size - 1:
+                continue
+            for r in range(n):
+                if r in s:
+                    continue
+                jr = join_rows(rows, s, r)
+                if jr is None:
+                    continue
+                ns = s | {r}
+                ncost = cost + jr
+                cur = new.get(ns)
+                if cur is None or ncost < cur[0]:
+                    new[ns] = (ncost, jr, order + [r])
+        if not new:
+            return None  # graph disconnected at some width: keep original
+        dp.update(new)
+    full = dp.get(frozenset(range(n)))
+    return full[2] if full else None
+
+
+def _greedy_order(n, rel_rows, join_rows, edges, ndvs) -> Optional[list[int]]:
+    """Wide regions: start from the cheapest edge, then repeatedly absorb the
+    connected relation that minimizes the next intermediate size."""
+    best0 = None
+    for ei, (ra, rb, _, _) in enumerate(edges):
+        rows = max(1.0, rel_rows[ra] * rel_rows[rb] / ndvs[ei])
+        start = [ra, rb] if rel_rows[ra] >= rel_rows[rb] else [rb, ra]
+        if best0 is None or rows < best0[0]:
+            best0 = (rows, start)
+    if best0 is None:
+        return None
+    rows, order = best0
+    members = frozenset(order)
+    while len(order) < n:
+        best = None
+        for r in range(n):
+            if r in members:
+                continue
+            jr = join_rows(rows, members, r)
+            if jr is None:
+                continue
+            if best is None or jr < best[0]:
+                best = (jr, r)
+        if best is None:
+            return None
+        rows, r = best
+        order.append(r)
+        members = members | {r}
+    return order

@@ -263,7 +263,7 @@ class Coordinator:
             raise RuntimeError("no alive workers")
         nw = len(workers)
 
-        plan = optimize(self.planner.plan(record["sql"]))
+        plan = optimize(self.planner.plan(record["sql"]), self.catalogs)
         dplan = distribute(plan, self.catalogs, nw, self.session)
         fragments = fragment_plan(dplan)
         record["columns"] = list(plan.output_names)
@@ -598,7 +598,7 @@ def _statement_surface(coord: "Coordinator"):
             self.tracer = Tracer()
 
         def plan(self, sql_or_query):
-            return optimize(self.planner.plan(sql_or_query))
+            return optimize(self.planner.plan(sql_or_query), self.catalogs)
 
         def query(self, sql_or_query) -> list[tuple]:
             # unmanaged: the enclosing statement already holds the group slot
