@@ -120,3 +120,47 @@ def test_reorder_shrinks_intermediates(engine):
     # bad order: fact x dim joins all 20k rows first; good order: the
     # t_id < 2 filter cuts the spine to ~2k before dim ever joins
     assert rows_reord < rows_base / 2, (rows_reord, rows_base)
+
+
+def test_scalar_subquery_single_row_and_multi_row_error(engine):
+    """Uncorrelated non-aggregate scalar subqueries broadcast their single
+    row; more than one row raises (reference: EnforceSingleRowOperator)."""
+    rows = engine.query(
+        "SELECT count(*) AS c FROM fact"
+        " WHERE f_tiny = (SELECT t_id FROM tiny WHERE t_id = 3)"
+    )
+    conn = engine.catalogs.get("mem")
+    expected = int((conn._data["fact"]["f_tiny"] == 3).sum())
+    assert rows == [(expected,)]
+
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="multiple rows"):
+        engine.query(
+            "SELECT count(*) AS c FROM fact"
+            " WHERE f_tiny = (SELECT t_id FROM tiny WHERE t_id < 3)"
+        )
+
+
+def test_scalar_subquery_multi_row_error_distributed(engine):
+    """The EnforceSingleRow guard also fires on the SPMD path (the count is
+    pmax-reduced across devices after the gather exchange)."""
+    from trino_tpu.runtime.engine import Engine
+
+    deng = Engine(default_catalog="mem", distributed=True)
+    deng.register_catalog("mem", engine.catalogs.get("mem"))
+    ok = deng.query(
+        "SELECT count(*) AS c FROM fact"
+        " WHERE f_tiny = (SELECT t_id FROM tiny WHERE t_id = 3)"
+    )
+    conn = engine.catalogs.get("mem")
+    import numpy as np
+
+    assert ok == [(int((conn._data["fact"]["f_tiny"] == 3).sum()),)]
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="multiple rows"):
+        deng.query(
+            "SELECT count(*) AS c FROM fact"
+            " WHERE f_tiny = (SELECT t_id FROM tiny WHERE t_id < 3)"
+        )

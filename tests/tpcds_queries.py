@@ -239,6 +239,83 @@ where d_date between date '1999-02-01' and date '1999-02-01' + interval '60' day
 """
 ORDERED["q95"] = True
 
+# Q64 (full): the 18-relation cross_sales region — store_sales x returns x
+# cs_ui x 3 date roles x 2 demographic/household/address/income-band roles x
+# store x promotion x item — self-joined across consecutive years.  The join
+# graph exceeds the reorder DP limit, exercising the greedy order
+# (plan/reorder.py _greedy_order).  Substitution parameters adapted to the
+# generated distributions (price band widened; colors from the generator's
+# palette).
+QUERIES["q64"] = """
+with cs_ui as
+ (select cs_item_sk,
+         sum(cs_ext_list_price) as sale,
+         sum(cr_refunded_cash + cr_reversed_charge + cr_store_credit) as refund
+    from catalog_sales, catalog_returns
+   where cs_item_sk = cr_item_sk and cs_order_number = cr_order_number
+   group by cs_item_sk
+  having sum(cs_ext_list_price) > 2 * sum(cr_refunded_cash + cr_reversed_charge + cr_store_credit)),
+cross_sales as
+ (select i_product_name as product_name, i_item_sk as item_sk,
+         s_store_name as store_name, s_zip as store_zip,
+         ad1.ca_street_number as b_street_number, ad1.ca_street_name as b_street_name,
+         ad1.ca_city as b_city, ad1.ca_zip as b_zip,
+         ad2.ca_street_number as c_street_number, ad2.ca_street_name as c_street_name,
+         ad2.ca_city as c_city, ad2.ca_zip as c_zip,
+         d1.d_year as syear, d2.d_year as fsyear, d3.d_year as s2year,
+         count(*) as cnt,
+         sum(ss_wholesale_cost) as s1, sum(ss_list_price) as s2,
+         sum(ss_coupon_amt) as s3
+    from store_sales, store_returns, cs_ui,
+         date_dim d1, date_dim d2, date_dim d3,
+         store, customer, customer_demographics cd1, customer_demographics cd2,
+         promotion, household_demographics hd1, household_demographics hd2,
+         customer_address ad1, customer_address ad2,
+         income_band ib1, income_band ib2, item
+   where ss_store_sk = s_store_sk
+     and ss_sold_date_sk = d1.d_date_sk
+     and ss_customer_sk = c_customer_sk
+     and ss_cdemo_sk = cd1.cd_demo_sk
+     and ss_hdemo_sk = hd1.hd_demo_sk
+     and ss_addr_sk = ad1.ca_address_sk
+     and ss_item_sk = i_item_sk
+     and ss_item_sk = sr_item_sk
+     and ss_ticket_number = sr_ticket_number
+     and ss_item_sk = cs_ui.cs_item_sk
+     and c_current_cdemo_sk = cd2.cd_demo_sk
+     and c_current_hdemo_sk = hd2.hd_demo_sk
+     and c_current_addr_sk = ad2.ca_address_sk
+     and c_first_sales_date_sk = d2.d_date_sk
+     and c_first_shipto_date_sk = d3.d_date_sk
+     and ss_promo_sk = p_promo_sk
+     and hd1.hd_income_band_sk = ib1.ib_income_band_sk
+     and hd2.hd_income_band_sk = ib2.ib_income_band_sk
+     and cd1.cd_marital_status <> cd2.cd_marital_status
+     and i_color in ('azure', 'beige', 'black', 'blue', 'brown', 'green')
+     and i_current_price between 1 and 1 + 98
+     and i_current_price between 1 + 1 and 1 + 99
+   group by i_product_name, i_item_sk, s_store_name, s_zip,
+            ad1.ca_street_number, ad1.ca_street_name, ad1.ca_city, ad1.ca_zip,
+            ad2.ca_street_number, ad2.ca_street_name, ad2.ca_city, ad2.ca_zip,
+            d1.d_year, d2.d_year, d3.d_year)
+select cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.b_street_number, cs1.b_street_name, cs1.b_city, cs1.b_zip,
+       cs1.c_street_number, cs1.c_street_name, cs1.c_city, cs1.c_zip,
+       cs1.syear, cs1.cnt,
+       cs1.s1 as s11, cs1.s2 as s21, cs1.s3 as s31,
+       cs2.s1 as s12, cs2.s2 as s22, cs2.s3 as s32, cs2.syear as syear2,
+       cs2.cnt as cnt2
+  from cross_sales cs1, cross_sales cs2
+ where cs1.item_sk = cs2.item_sk
+   and cs1.syear = 1999
+   and cs2.syear = 1999 + 1
+   and cs2.cnt <= cs1.cnt
+   and cs1.store_name = cs2.store_name
+   and cs1.store_zip = cs2.store_zip
+ order by cs1.product_name, cs1.store_name, cs2.cnt, cs1.s1, s12
+"""
+ORDERED["q64"] = False
+
 # Q64-lite: the cross-channel CTE joined against itself across two years —
 # the structural core of Q64's cs1/cs2 pattern (full Q64's 20-way dimension
 # join reuses patterns covered elsewhere in this suite)
@@ -262,3 +339,369 @@ order by cs1.item_sk, cs1.sale
 limit 100
 """
 ORDERED["q64lite"] = False
+
+QUERIES["q06"] = """
+select a.ca_state as state, count(*) as cnt
+from customer_address a, customer c, store_sales s, date_dim d, item i
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk
+  and s.ss_item_sk = i.i_item_sk
+  and d.d_month_seq = (select distinct d_month_seq from date_dim
+                        where d_year = 1999 and d_moy = 1)
+  and i.i_current_price > (select 1.2 * avg(j.i_current_price) from item j
+                            where j.i_category = i.i_category)
+group by a.ca_state
+having count(*) >= 1
+order by cnt, a.ca_state
+limit 100
+"""
+ORDERED["q06"] = False
+
+QUERIES["q13"] = """
+select avg(ss_quantity) as a1, avg(ss_ext_sales_price) as a2,
+       avg(ss_ext_wholesale_cost) as a3, sum(ss_ext_wholesale_cost) as a4
+from store_sales, store, customer_demographics, household_demographics,
+     customer_address, date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M' and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00 and hd_dep_count = 3)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S' and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00 and hd_dep_count = 1)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'W' and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00 and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'MI') and ss_net_profit between 100 and 200)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('CA', 'GA', 'NY') and ss_net_profit between 150 and 300)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA', 'TN', 'WA') and ss_net_profit between 50 and 250))
+"""
+ORDERED["q13"] = True
+
+QUERIES["q15"] = """
+select ca_zip, sum(cs_sales_price) as total
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substring(ca_zip, 1, 2) in ('85', '86', '88') or ca_state in ('CA', 'WA', 'GA')
+       or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk and d_qoy = 1 and d_year = 2000
+group by ca_zip
+order by ca_zip
+limit 100
+"""
+ORDERED["q15"] = True
+
+QUERIES["q25"] = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 2000 and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10 and d2.d_year = 2000
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10 and d3.d_year = 2000
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+ORDERED["q25"] = True
+
+QUERIES["q29"] = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) as store_sales_quantity,
+       sum(sr_return_quantity) as store_returns_quantity,
+       sum(cs_quantity) as catalog_sales_quantity
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 1999 and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 4 + 3 and d2.d_year = 1999
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_year in (1999, 1999 + 1, 1999 + 2)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+ORDERED["q29"] = True
+
+QUERIES["q32"] = """
+select sum(cs_ext_discount_amt) as excess_discount_amount
+from catalog_sales, item, date_dim
+where i_manufact_id < 200
+  and i_item_sk = cs_item_sk
+  and d_date between date '2000-01-27' and date '2000-01-27' + interval '90' day
+  and d_date_sk = cs_sold_date_sk
+  and cs_ext_discount_amt > (
+      select 1.3 * avg(cs_ext_discount_amt)
+      from catalog_sales cs2, date_dim d2
+      where cs2.cs_item_sk = i_item_sk
+        and d2.d_date between date '2000-01-27' and date '2000-01-27' + interval '90' day
+        and d2.d_date_sk = cs2.cs_sold_date_sk)
+"""
+ORDERED["q32"] = True
+
+QUERIES["q34"] = """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (d_dom between 1 and 3 or d_dom between 25 and 28)
+        and (hd_buy_potential = '>10000' or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0
+        and (hd_dep_count * 1.0 / hd_vehicle_count) > 1.2
+        and d_year in (1998, 1998 + 1, 1998 + 2)
+        and s_county in ('Adams County', 'Bronx County', 'Cook County', 'Dallas County')
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 20
+order by c_last_name, c_first_name, c_salutation, c_preferred_cust_flag desc,
+         ss_ticket_number
+"""
+ORDERED["q34"] = False
+
+QUERIES["q38"] = """
+select count(*) as cnt from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    and store_sales.ss_customer_sk = customer.c_customer_sk
+    and d_month_seq between 96 and 96 + 11
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 96 and 96 + 11
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 96 and 96 + 11
+) hot_cust
+"""
+ORDERED["q38"] = True
+
+QUERIES["q40"] = """
+select w_state, i_item_id,
+  sum(case when d_date < date '2000-03-11'
+           then cs_sales_price - coalesce(cr_refunded_cash, 0) else 0 end) as sales_before,
+  sum(case when d_date >= date '2000-03-11'
+           then cs_sales_price - coalesce(cr_refunded_cash, 0) else 0 end) as sales_after
+from catalog_sales
+     left outer join catalog_returns
+       on (cs_order_number = cr_order_number and cs_item_sk = cr_item_sk),
+     warehouse, item, date_dim
+where i_current_price between 10 and 60
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '2000-02-10' and date '2000-03-11' + interval '30' day
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+"""
+ORDERED["q40"] = True
+
+QUERIES["q43"] = """
+select s_store_name, s_store_id,
+  sum(case when d_day_name = 'Sunday' then ss_sales_price else null end) as sun_sales,
+  sum(case when d_day_name = 'Monday' then ss_sales_price else null end) as mon_sales,
+  sum(case when d_day_name = 'Tuesday' then ss_sales_price else null end) as tue_sales,
+  sum(case when d_day_name = 'Wednesday' then ss_sales_price else null end) as wed_sales,
+  sum(case when d_day_name = 'Thursday' then ss_sales_price else null end) as thu_sales,
+  sum(case when d_day_name = 'Friday' then ss_sales_price else null end) as fri_sales,
+  sum(case when d_day_name = 'Saturday' then ss_sales_price else null end) as sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and s_gmt_offset = -5 and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+"""
+ORDERED["q43"] = True
+
+QUERIES["q46"] = """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city as bought_city,
+             sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+      from store_sales, date_dim, store, household_demographics, customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+        and d_dow in (6, 0)
+        and d_year in (1999, 1999 + 1, 1999 + 2)
+        and s_city in ('Midway', 'Fairview')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100
+"""
+ORDERED["q46"] = True
+
+QUERIES["q50"] = """
+select s_store_name, s_company_id, s_street_number, s_street_name,
+       s_street_type, s_suite_number, s_city, s_county, s_state, s_zip,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30) then 1 else 0 end) as d30,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30)
+            and (sr_returned_date_sk - ss_sold_date_sk <= 60) then 1 else 0 end) as d60,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 60)
+            and (sr_returned_date_sk - ss_sold_date_sk <= 90) then 1 else 0 end) as d90,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 90)
+            and (sr_returned_date_sk - ss_sold_date_sk <= 120) then 1 else 0 end) as d120,
+  sum(case when (sr_returned_date_sk - ss_sold_date_sk > 120) then 1 else 0 end) as d120plus
+from store_sales, store_returns, store, date_dim d1, date_dim d2
+where d2.d_year = 2001 and d2.d_moy = 8
+  and ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk
+  and ss_sold_date_sk = d1.d_date_sk and sr_returned_date_sk = d2.d_date_sk
+  and ss_customer_sk = sr_customer_sk and ss_store_sk = s_store_sk
+group by s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+order by s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+limit 100
+"""
+ORDERED["q50"] = True
+
+QUERIES["q65"] = """
+select s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from store, item,
+     (select ss_store_sk, avg(revenue) as ave
+        from (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+                from store_sales, date_dim
+               where ss_sold_date_sk = d_date_sk and d_month_seq between 96 and 96 + 11
+               group by ss_store_sk, ss_item_sk) sa
+       group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+        from store_sales, date_dim
+       where ss_sold_date_sk = d_date_sk and d_month_seq between 96 and 96 + 11
+       group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk
+  and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc
+limit 100
+"""
+ORDERED["q65"] = False  # revenue ties across items with equal desc
+
+QUERIES["q73"] = """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and d_dom between 1 and 2
+        and (hd_buy_potential = '>10000' or hd_buy_potential = '0-500')
+        and hd_vehicle_count > 0
+        and (hd_dep_count * 1.0 / hd_vehicle_count) > 1
+        and d_year in (2000, 2000 + 1, 2000 + 2)
+        and s_county in ('Kent County', 'Lake County', 'Polk County', 'Wayne County')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by cnt desc, c_last_name asc
+"""
+ORDERED["q73"] = False  # count ties
+
+QUERIES["q90"] = """
+select cast(amc as double) / cast(pmc as double) as am_pm_ratio
+from (select count(*) as amc from web_sales, household_demographics, time_dim, web_page
+      where ws_sold_time_sk = time_dim.t_time_sk
+        and ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        and ws_web_page_sk = web_page.wp_web_page_sk
+        and t_hour between 8 and 9
+        and household_demographics.hd_dep_count = 6) at1,
+     (select count(*) as pmc from web_sales, household_demographics, time_dim, web_page
+      where ws_sold_time_sk = time_dim.t_time_sk
+        and ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        and ws_web_page_sk = web_page.wp_web_page_sk
+        and t_hour between 19 and 20
+        and household_demographics.hd_dep_count = 6) pt1
+order by am_pm_ratio
+"""
+ORDERED["q90"] = True
+
+QUERIES["q93"] = """
+select ss_customer_sk, sum(act_sales) as sumsales
+from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity) * ss_sales_price
+                  else ss_quantity * ss_sales_price end as act_sales
+      from store_sales
+           left outer join store_returns
+             on (sr_item_sk = ss_item_sk and sr_ticket_number = ss_ticket_number),
+           reason
+      where sr_reason_sk = r_reason_sk
+        and r_reason_desc = 'Stopped working') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+"""
+ORDERED["q93"] = True
+
+QUERIES["q97"] = """
+with ssci as
+ (select ss_customer_sk as customer_sk, ss_item_sk as item_sk
+    from store_sales, date_dim
+   where ss_sold_date_sk = d_date_sk and d_month_seq between 96 and 96 + 11
+   group by ss_customer_sk, ss_item_sk),
+csci as
+ (select cs_bill_customer_sk as customer_sk, cs_item_sk as item_sk
+    from catalog_sales, date_dim
+   where cs_sold_date_sk = d_date_sk and d_month_seq between 96 and 96 + 11
+   group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null and csci.customer_sk is null
+                then 1 else 0 end) as store_only,
+       sum(case when ssci.customer_sk is null and csci.customer_sk is not null
+                then 1 else 0 end) as catalog_only,
+       sum(case when ssci.customer_sk is not null and csci.customer_sk is not null
+                then 1 else 0 end) as store_and_catalog
+from ssci full outer join csci
+  on (ssci.customer_sk = csci.customer_sk and ssci.item_sk = csci.item_sk)
+"""
+ORDERED["q97"] = True
+
+QUERIES["q99"] = """
+select substring(w_warehouse_name, 1, 20) as wname, sm_type, cc_name,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk <= 30) then 1 else 0 end) as d30,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 30)
+            and (cs_ship_date_sk - cs_sold_date_sk <= 60) then 1 else 0 end) as d60,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 60)
+            and (cs_ship_date_sk - cs_sold_date_sk <= 90) then 1 else 0 end) as d90,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 90)
+            and (cs_ship_date_sk - cs_sold_date_sk <= 120) then 1 else 0 end) as d120,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 120) then 1 else 0 end) as d120plus
+from catalog_sales, warehouse, ship_mode, call_center, date_dim
+where d_month_seq between 96 and 96 + 23
+  and cs_ship_date_sk = d_date_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by substring(w_warehouse_name, 1, 20), sm_type, cc_name
+order by wname, sm_type, cc_name
+limit 100
+"""
+ORDERED["q99"] = True

@@ -411,12 +411,21 @@ def _gen_customer(scale: float):
     n_addr = _rows("customer_address", scale)
     n_cd = _rows("customer_demographics", scale)
     n_hd = _rows("household_demographics", scale)
+    nd = _date_dim_size()
     return {
         "c_customer_sk": sk,
         "c_customer_id": _ids("C", sk),
         "c_current_cdemo_sk": rng.integers(1, n_cd + 1, size=n).astype(np.int64),
         "c_current_hdemo_sk": rng.integers(1, n_hd + 1, size=n).astype(np.int64),
         "c_current_addr_sk": rng.integers(1, n_addr + 1, size=n).astype(np.int64),
+        # first-sale/first-shipto dates land in the date_dim sk range so
+        # Q64-class joins (c_first_sales_date_sk = d2.d_date_sk) resolve
+        "c_first_sales_date_sk": rng.integers(
+            _SK_BASE, _SK_BASE + nd, size=n
+        ).astype(np.int64),
+        "c_first_shipto_date_sk": rng.integers(
+            _SK_BASE, _SK_BASE + nd, size=n
+        ).astype(np.int64),
         "c_salutation": _pick(rng, ["Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir"], n),
         "c_first_name": _pick(rng, ["James", "Mary", "John", "Linda", "Robert", "Susan", "David", "Karen"], n),
         "c_last_name": _pick(rng, ["Smith", "Jones", "Brown", "Davis", "Miller", "Wilson", "Moore", "Taylor"], n),
@@ -700,6 +709,9 @@ def _gen_store_sales(scale: float):
 
 
 def _gen_store_returns(scale: float):
+    """Returns reference actual sale rows (dsdgen derives each return from a
+    parent sale), so ss_item_sk = sr_item_sk AND ss_ticket_number =
+    sr_ticket_number joins resolve — the Q64/q64lite/q93 join shape."""
     n = _rows("store_returns", scale)
     rng = _rng("store_returns", scale)
     n_sales = _rows("store_sales", scale)
@@ -707,20 +719,30 @@ def _gen_store_returns(scale: float):
     qty = rng.integers(1, 50, size=n).astype(np.int32)
     amt = _money(rng, n, 1.0, 500.0)
     date_fk, _ = _fk(rng, n, nd)
+    from . import tpcds_data  # session cache; safe at call time
+
+    sales = tpcds_data("store_sales", scale)
+    sale_row = rng.integers(0, n_sales, size=n)
+    cash = np.round(amt * rng.random(n) * 0.5, 2)
+    charge = np.round(amt * rng.random(n) * 0.3, 2)
     return {
         "sr_returned_date_sk": np.where(date_fk > 0, date_fk + _SK_BASE - 1, date_fk),
-        "sr_item_sk": rng.integers(1, _rows("item", scale) + 1, size=n).astype(np.int64),
-        "sr_customer_sk": _fk(rng, n, _rows("customer", scale))[0],
+        "sr_item_sk": sales["ss_item_sk"][sale_row],
+        # the returning customer is the purchasing customer (dsdgen does the
+        # same) — q25/q29-class ss x sr joins key on it
+        "sr_customer_sk": sales["ss_customer_sk"][sale_row],
         "sr_store_sk": _fk(rng, n, _rows("store", scale))[0],
         "sr_reason_sk": _fk(rng, n, _rows("reason", scale))[0],
-        "sr_ticket_number": rng.integers(1, n_sales + 1, size=n).astype(np.int64),
+        "sr_ticket_number": sales["ss_ticket_number"][sale_row],
         "sr_return_quantity": qty,
         "sr_return_amt": amt,
         "sr_return_tax": np.round(amt * 0.05, 2),
         "sr_return_amt_inc_tax": np.round(amt * 1.05, 2),
         "sr_fee": _money(rng, n, 0.5, 100.0),
         "sr_return_ship_cost": _money(rng, n, 0.0, 50.0),
-        "sr_refunded_cash": np.round(amt * rng.random(n), 2),
+        "sr_refunded_cash": cash,
+        "sr_reversed_charge": charge,
+        "sr_store_credit": np.round(amt - cash - charge, 2).clip(min=0.0),
         "sr_net_loss": _money(rng, n, 0.5, 300.0),
     }
 
@@ -769,25 +791,37 @@ def _gen_catalog_sales(scale: float):
 
 
 def _gen_catalog_returns(scale: float):
+    """Returns reference actual catalog_sales rows (cr_item_sk +
+    cr_order_number pairs come from a parent sale) so Q64's cs_ui CTE join
+    cs_item_sk = cr_item_sk AND cs_order_number = cr_order_number resolves."""
     n = _rows("catalog_returns", scale)
     rng = _rng("catalog_returns", scale)
     nd = _date_dim_size()
     amt = _money(rng, n, 1.0, 500.0)
     date_fk, _ = _fk(rng, n, nd)
+    from . import tpcds_data  # session cache; safe at call time
+
+    sales = tpcds_data("catalog_sales", scale)
+    sale_row = rng.integers(0, _rows("catalog_sales", scale), size=n)
+    cash = np.round(amt * rng.random(n) * 0.5, 2)
+    charge = np.round(amt * rng.random(n) * 0.3, 2)
     return {
         "cr_returned_date_sk": np.where(date_fk > 0, date_fk + _SK_BASE - 1, date_fk),
-        "cr_item_sk": rng.integers(1, _rows("item", scale) + 1, size=n).astype(np.int64),
+        "cr_item_sk": sales["cs_item_sk"][sale_row],
         "cr_refunded_customer_sk": _fk(rng, n, _rows("customer", scale))[0],
         "cr_returning_customer_sk": _fk(rng, n, _rows("customer", scale))[0],
         "cr_call_center_sk": _fk(rng, n, _rows("call_center", scale))[0],
         "cr_catalog_page_sk": _fk(rng, n, _rows("catalog_page", scale))[0],
         "cr_reason_sk": _fk(rng, n, _rows("reason", scale))[0],
-        "cr_order_number": rng.integers(1, _rows("catalog_sales", scale) + 1, size=n).astype(np.int64),
+        "cr_order_number": sales["cs_order_number"][sale_row],
         "cr_return_quantity": rng.integers(1, 50, size=n).astype(np.int32),
         "cr_return_amount": amt,
         "cr_return_tax": np.round(amt * 0.05, 2),
         "cr_return_amt_inc_tax": np.round(amt * 1.05, 2),
         "cr_fee": _money(rng, n, 0.5, 100.0),
+        "cr_refunded_cash": cash,
+        "cr_reversed_charge": charge,
+        "cr_store_credit": np.round(amt - cash - charge, 2).clip(min=0.0),
         "cr_net_loss": _money(rng, n, 0.5, 300.0),
     }
 

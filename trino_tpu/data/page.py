@@ -158,9 +158,20 @@ class Column:
         if type_.is_string:
             codes, dictionary = Dictionary.encode(values)
             return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
+        arr = np.asarray(values, dtype=type_.np_dtype)
+        if arr.dtype == np.int64 and arr.size:
+            # Lane narrowing: TPUs have no native int64 (every 64-bit
+            # compare/sort emulates on 32-bit halves), so BIGINT/DECIMAL
+            # lanes whose values fit int32 upload narrowed — sorts, joins
+            # and group keys run native-width and HBM traffic halves.  The
+            # logical type stays 64-bit: expression arithmetic re-widens
+            # (ops/expr.py) so products can't overflow the narrow lanes.
+            mn, mx = arr.min(), arr.max()
+            if -(2**31) < mn and mx < 2**31:
+                arr = arr.astype(np.int32)
         return Column(
             type_,
-            jnp.asarray(np.asarray(values, dtype=type_.np_dtype)),
+            jnp.asarray(arr),
             None if valid is None else jnp.asarray(valid),
         )
 

@@ -34,8 +34,9 @@ from ..ops.relops import (
     limit_mask, sort_rows, top_n, unnest_expand,
 )
 from ..plan.nodes import (
-    Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit, PlanNode,
-    Project, RemoteSource, Sort, TableScan, TopN, Unnest, Values, Window,
+    Aggregate, Concat, Distinct, EnforceSingleRow, Exchange, Filter, Join,
+    Limit, PlanNode, Project, RemoteSource, Sort, TableScan, TopN, Unnest,
+    Values, Window,
 )
 
 __all__ = ["LocalExecutor"]
@@ -152,7 +153,18 @@ class LocalExecutor:
                 keep = np.ones((nrows,), dtype=bool)
                 for f in filters:
                     vals = data[f.column]
-                    if isinstance(vals, np.ma.MaskedArray):
+                    if f.values is not None:
+                        # dictionary-set domain (string keys): membership
+                        base = (
+                            np.ma.getdata(vals)
+                            if isinstance(vals, np.ma.MaskedArray)
+                            else vals
+                        )
+                        ok = np.isin(base, np.asarray(f.values, dtype=object))
+                        if isinstance(vals, np.ma.MaskedArray):
+                            ok &= ~np.ma.getmaskarray(vals)
+                        keep &= ok
+                    elif isinstance(vals, np.ma.MaskedArray):
                         # NULL probe keys never equi-match: prune them too
                         ok = (vals >= f.min) & (vals <= f.max)
                         keep &= np.asarray(ok.filled(False))
@@ -245,6 +257,11 @@ class LocalExecutor:
                         caps[nid] = _pow2(max(req, caps[nid] * 2))
         for _ in range(12):  # capacity-retry loop (jitted path)
             out_page, required = self._run(plan, inputs, caps)
+            for key, val in required.items():
+                if isinstance(key, int) and key < 0 and int(val) > 1:
+                    raise RuntimeError(
+                        "Scalar sub-query has returned multiple rows"
+                    )
             overflow = {
                 nid: int(req)
                 for nid, req in required.items()
@@ -505,6 +522,15 @@ def _trace_plan(
             for cv, t in zip(cols, node.output_types):
                 cv.type = t
             return _Stage(cols, page.live_mask())
+
+        if isinstance(node, EnforceSingleRow):
+            s = emit(node.child)
+            # host raises when this exceeds 1 (scalar-subquery contract;
+            # reference: EnforceSingleRowOperator) — kernels cannot raise.
+            # Key is -(nid+1): `required` flows through shard_map as a pytree
+            # dict whose keys must sort together, so specials stay ints
+            report(-(nid + 1), jnp.sum(s.live.astype(jnp.int32)))
+            return s
 
         if isinstance(node, Filter):
             s = emit(node.child)

@@ -32,12 +32,22 @@ __all__ = ["ScanFilter", "collect_dynamic_filters"]
 
 @dataclass(frozen=True)
 class ScanFilter:
-    """Range filter on one scan column (reference: TupleDomain of a
-    dynamic filter)."""
+    """Domain filter on one scan column (reference: TupleDomain of a
+    dynamic filter): numeric [min, max] range, or — for dictionary-coded
+    string keys — an explicit sorted value set (the reference's discrete
+    TupleDomain; TPC-DS star joins key on strings/surrogates, so range
+    domains alone leave them unpruned)."""
 
     column: str
-    min: float
-    max: float
+    min: float = 0.0
+    max: float = 0.0
+    values: Optional[tuple] = None  # sorted distinct values; None == range
+
+
+# build sides with more distinct strings than this skip the set domain (the
+# reference's dynamic-filtering max-distinct limit); membership tests on the
+# host scale with the set
+_MAX_SET_VALUES = 100_000
 
 
 def _scan_under(node: PlanNode) -> Optional[TableScan]:
@@ -85,21 +95,32 @@ def collect_dynamic_filters(
             if lk.index >= len(scan.column_names):
                 continue
             col = page.columns[rk.index]
-            if col.type.is_string or col.type.np_dtype == np.dtype(np.bool_):
-                continue  # range domains are numeric; dict sets are future work
+            if col.type.np_dtype == np.dtype(np.bool_):
+                continue
             keep = live.copy()
             if col.valid is not None:
                 keep &= np.asarray(col.valid)
             data = np.asarray(col.data)[keep]
             if len(data) == 0:
                 continue
-            out.setdefault(ids[id(scan)], []).append(
-                ScanFilter(
-                    scan.column_names[lk.index],
-                    float(data.min()),
-                    float(data.max()),
+            if col.type.is_string:
+                # dictionary-set domain: live build codes -> distinct values
+                if col.dictionary is None or len(col.dictionary) > _MAX_SET_VALUES:
+                    continue
+                codes = np.unique(data)
+                codes = codes[(codes >= 0) & (codes < len(col.dictionary))]
+                values = tuple(sorted(col.dictionary.values[codes]))
+                out.setdefault(ids[id(scan)], []).append(
+                    ScanFilter(scan.column_names[lk.index], values=values)
                 )
-            )
+            else:
+                out.setdefault(ids[id(scan)], []).append(
+                    ScanFilter(
+                        scan.column_names[lk.index],
+                        float(data.min()),
+                        float(data.max()),
+                    )
+                )
 
     visit(root)
     return {nid: tuple(fs) for nid, fs in out.items()}

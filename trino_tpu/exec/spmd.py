@@ -105,6 +105,11 @@ class SpmdExecutor(LocalExecutor):
                         caps[nid] = _pow2(max(req, caps[nid] * 2))
         for _ in range(14):
             out_page, required = self._run_spmd(plan, inputs, caps)
+            for key, val in required.items():
+                if isinstance(key, int) and key < 0 and int(val) > 1:
+                    raise RuntimeError(
+                        "Scalar sub-query has returned multiple rows"
+                    )
             overflow = {
                 nid: int(req)
                 for nid, req in required.items()

@@ -156,8 +156,15 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
             if v.valid is None:
                 out = v
             else:
+                # merge in a dtype wide enough for BOTH branches: lanes may
+                # be narrowed int32 (data/page.py) while the fallback still
+                # carries true int64 values — casting the fallback down
+                # would silently truncate it
+                merged = jnp.promote_types(v.data.dtype, out.data.dtype)
                 out = ColumnVal(
-                    jnp.where(v.valid, v.data, out.data.astype(v.data.dtype)),
+                    jnp.where(
+                        v.valid, v.data.astype(merged), out.data.astype(merged)
+                    ),
                     None if out.valid is None else (v.valid | out.valid),
                     v.dict,
                     v.type,

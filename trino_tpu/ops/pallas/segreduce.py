@@ -301,6 +301,8 @@ def fused_segment_reduce(
     *,
     interpret: bool = False,
     force_pallas: bool = False,
+    sorted_segments: bool = False,
+    boundaries: Optional[tuple] = None,
 ) -> list[jnp.ndarray]:
     """Compute every requested reduction in one fused pass.
 
@@ -321,6 +323,13 @@ def fused_segment_reduce(
     interpret = interpret or INTERPRET
     use_pallas = force_pallas or interpret or pallas_segreduce_supported(G)
     if not use_pallas:
+        if sorted_segments:
+            # high-cardinality group-by over the sort-based path: rows arrive
+            # ordered by segment, so boundary gathers + cumsum diffs beat the
+            # scatter-based segment ops (XLA scatter serializes on TPU — at
+            # TPC-H SF1 Q3's ~1M groups the scatter fallback cost ~36s of
+            # device time; this path is bandwidth-bound)
+            return _sorted_fallback(seg, reds, G, boundaries)
         return _xla_fallback(seg, reds, G)
 
     g_pad = max(_GTILE, -(-(G + 1) // _GTILE) * _GTILE)
@@ -481,6 +490,85 @@ def fused_segment_reduce(
 # --------------------------------------------------------------------------
 # XLA fallback (CPU tests / G beyond the one-hot ceiling)
 # --------------------------------------------------------------------------
+
+
+def _seg_scan_extreme(vals, flag, is_min):
+    """Per-row running min/max within each contiguous segment (flag marks
+    segment starts).  The segmented-combine operator is associative, so the
+    whole pass is one log-depth associative_scan — no scatter."""
+
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        combined = jnp.minimum(va, vb) if is_min else jnp.maximum(va, vb)
+        return jnp.where(fb, vb, combined), fa | fb
+
+    pv, _ = jax.lax.associative_scan(op, (vals, flag))
+    return pv
+
+
+def _sorted_fallback(seg, reds, G, boundaries=None):
+    """Segment reductions for NONDECREASING seg (the sort-based group-by's
+    output order): sums/counts via diffs of one inclusive cumsum at segment
+    boundaries, min/max via a segmented associative scan read at segment
+    ends.  Everything is gathers + scans — the shape TPUs like.
+    `boundaries` = precomputed (starts, ends) searchsorted results (the
+    caller shares one boundary pass across key gathers and reductions)."""
+    n = seg.shape[0]
+    seg_c = jnp.minimum(seg.astype(jnp.int32), G)
+    if boundaries is not None:
+        starts, ends = boundaries
+    else:
+        from ..relops import searchsorted_tpu
+
+        gids = jnp.arange(G, dtype=jnp.int32)
+        starts = searchsorted_tpu(seg_c, gids, side="left")
+        ends = searchsorted_tpu(seg_c, gids, side="right")
+    nonempty = ends > starts
+    ends_i = jnp.clip(ends - 1, 0, max(n - 1, 0))
+    flag = (
+        jnp.concatenate([jnp.ones((1,), jnp.bool_), seg_c[1:] != seg_c[:-1]])
+        if n > 0
+        else jnp.ones((0,), jnp.bool_)
+    )
+
+    def boundary_sum(acc):
+        ce = jnp.concatenate([jnp.zeros((1,), acc.dtype), jnp.cumsum(acc)])
+        zero = jnp.zeros((), acc.dtype)
+        return jnp.where(nonempty, jnp.take(ce, ends) - jnp.take(ce, starts), zero)
+
+    out = []
+    for r in reds:
+        if r.op == "count":
+            v = (
+                r.valid.astype(jnp.int64)
+                if r.valid is not None
+                else jnp.ones((n,), jnp.int64)
+            )
+            out.append(boundary_sum(v))
+        elif r.op == "sum":
+            vals = r.values
+            if jnp.issubdtype(vals.dtype, jnp.integer) or vals.dtype == jnp.bool_:
+                acc = vals.astype(jnp.int64)
+            else:
+                acc = vals.astype(jnp.float64)
+            if r.valid is not None:
+                acc = jnp.where(r.valid, acc, jnp.zeros_like(acc))
+            out.append(boundary_sum(acc))
+        elif r.op in ("min", "max"):
+            sel = r.values
+            if jnp.issubdtype(sel.dtype, jnp.floating):
+                sent = jnp.asarray(jnp.inf if r.op == "min" else -jnp.inf, sel.dtype)
+            else:
+                info = jnp.iinfo(sel.dtype)
+                sent = jnp.asarray(info.max if r.op == "min" else info.min, sel.dtype)
+            if r.valid is not None:
+                sel = jnp.where(r.valid, sel, sent)
+            run = _seg_scan_extreme(sel, flag, r.op == "min")
+            out.append(jnp.where(nonempty, jnp.take(run, ends_i), sent))
+        else:
+            raise NotImplementedError(r.op)
+    return out
 
 
 def _xla_fallback(seg, reds, G):

@@ -59,17 +59,40 @@ def _valid_of(v: ColumnVal, n: int) -> jnp.ndarray:
 
 _MATMUL_SEGMENT_LIMIT = 1024
 
+_SEARCHSORTED_SORT_MIN = 4096
 
-def _segment_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
+
+def searchsorted_tpu(a: jnp.ndarray, v: jnp.ndarray, side: str = "left"):
+    """jnp.searchsorted with the method picked for TPU: the default binary
+    search lowers to log2(n) SEQUENTIAL gather rounds over HBM (~1.8s for
+    8M probes into 8M keys — measured; it was the q03/q18 bottleneck), while
+    'sort' does one fused bitonic pass over a++v (~30ms).  Small query sets
+    keep the scan — sorting the whole haystack for a handful of lookups
+    loses."""
+    method = "sort" if v.size >= _SEARCHSORTED_SORT_MIN else "scan"
+    return jnp.searchsorted(a, v, side=side, method=method)
+
+
+def _segment_sum(
+    values: jnp.ndarray, seg: jnp.ndarray, num: int, sorted_segments: bool = False
+) -> jnp.ndarray:
     """Backend-aware segment sum.  On CPU, XLA's scatter-add is fine.  On
     TPU, scatter serializes — but a one-hot matmul runs on the MXU, which is
     exactly how a TPU wants to aggregate (SURVEY §7: keep the FLOPs where
     the systolic array is).  Used when the segment count is small enough
-    that the [n, G] one-hot is cheap; scatter otherwise."""
+    that the [n, G] one-hot is cheap.  For NONDECREASING seg (the sorted
+    group-by's order) large segment counts use boundary cumsum diffs —
+    gathers and scans only, never a big scatter."""
     if jax.default_backend() != "cpu" and num <= _MATMUL_SEGMENT_LIMIT:
         if jnp.issubdtype(values.dtype, jnp.integer):
             return _limb_segment_sum(values, seg, num)
         return _chunked_f32_segment_sum(values, seg, num).astype(values.dtype)
+    if sorted_segments and jax.default_backend() != "cpu":
+        from .pallas.segreduce import SegRed, _sorted_fallback
+
+        return _sorted_fallback(seg, [SegRed("sum", values, None)], num)[0].astype(
+            values.dtype
+        )
     return jax.ops.segment_sum(values, seg, num_segments=num)
 
 
@@ -192,16 +215,28 @@ def group_aggregate(
     )
 
     # ---- output keys: first row of each segment ---------------------------
+    # seg is NONDECREASING (rows sorted by keys), so the first row of group g
+    # is a gather at searchsorted(seg, g) — no scatter (TPU scatters
+    # serialize; this was the high-cardinality group-by bottleneck).  One
+    # boundary pass is shared with the fused reductions below.
+    gids = jnp.arange(G, dtype=jnp.int32)
+    seg32 = jnp.minimum(seg.astype(jnp.int32), G)
+    starts = searchsorted_tpu(seg32, gids, side="left")
+    ends = searchsorted_tpu(seg32, gids, side="right")
+    starts_i = jnp.clip(starts, 0, max(n - 1, 0))
     out_keys: list[tuple[jnp.ndarray, Optional[jnp.ndarray]]] = []
     for kv in key_vals:
         data_s = jnp.take(kv.data, perm)
         valid_s = jnp.take(_valid_of(kv, n), perm)
-        kdata = _scatter_first(data_s, seg, new_group, G)
-        kvalid = _scatter_first(valid_s, seg, new_group, G)
-        out_keys.append((kdata, kvalid))
+        out_keys.append(
+            (jnp.take(data_s, starts_i), jnp.take(valid_s, starts_i))
+        )
 
     # ---- aggregates -------------------------------------------------------
-    out_aggs = _fused_aggs(agg_args, specs, perm, seg, live_s, G, n)
+    out_aggs = _fused_aggs(
+        agg_args, specs, perm, seg, live_s, G, n,
+        sorted_segments=True, boundaries=(starts, ends),
+    )
     for i, (arg, spec) in enumerate(zip(agg_args, specs)):
         if out_aggs[i] is None:  # DISTINCT/percentile: need sorted adjacency
             if i == vs_ix[0]:
@@ -267,7 +302,10 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live):
     return out_keys, out_aggs, out_live, n_groups
 
 
-def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
+def _fused_aggs(
+    agg_args, specs, perm, seg, live_s, G, n,
+    sorted_segments=False, boundaries=None,
+):
     """All non-DISTINCT aggregates of a GROUP BY in one fused segmented
     reduction (ops/pallas/segreduce.py): on TPU a single Pallas pass over HBM
     computes every SUM/COUNT/AVG on the MXU (exact int64 via limb
@@ -341,7 +379,13 @@ def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
         else:
             raise NotImplementedError(f"aggregate {spec.fn}")
 
-    results = fused_segment_reduce(seg, reds, G) if reds else []
+    results = (
+        fused_segment_reduce(
+            seg, reds, G, sorted_segments=sorted_segments, boundaries=boundaries
+        )
+        if reds
+        else []
+    )
 
     out: list = []
     for r in recipe:
@@ -394,13 +438,6 @@ def _fused_aggs(agg_args, specs, perm, seg, live_s, G, n):
     return out
 
 
-def _scatter_first(values: jnp.ndarray, seg: jnp.ndarray, new_group: jnp.ndarray, G: int):
-    idx = jnp.where(new_group, seg, G)
-    return jnp.zeros((G + 1,) + values.shape[1:], values.dtype).at[idx].set(
-        values, mode="drop"
-    )[:G]
-
-
 def _segment_agg(
     arg: Optional[ColumnVal],
     spec: AggSpec,
@@ -426,7 +463,7 @@ def _segment_agg(
     contrib = (new_val & valid_s).astype(jnp.int64)
     if spec.fn != "count":
         raise NotImplementedError(f"DISTINCT {spec.fn}")
-    out = _segment_sum(contrib, seg, num)[:G]
+    out = _segment_sum(contrib, seg, num, sorted_segments=True)[:G]
     return out, None
 
 
@@ -447,9 +484,9 @@ def _segment_percentile(
     natural fit for the sort-based group-by."""
     data_s = jnp.take(arg.data, perm)
     valid_s = jnp.take(_valid_of(arg, n), perm) & live_s
-    vcnt = _segment_sum(valid_s.astype(jnp.int64), seg, G + 1)[:G]
+    vcnt = _segment_sum(valid_s.astype(jnp.int64), seg, G + 1, sorted_segments=True)[:G]
     # group start among sorted rows (seg ascends over live rows, dead == G)
-    starts = jnp.searchsorted(seg, jnp.arange(G, dtype=seg.dtype), side="left")
+    starts = searchsorted_tpu(seg, jnp.arange(G, dtype=seg.dtype), side="left")
     off = jnp.floor(p * jnp.maximum(vcnt - 1, 0).astype(jnp.float64) + 0.5)
     idx = jnp.clip(starts + off.astype(jnp.int64), 0, max(n - 1, 0))
     vals = jnp.take(data_s, idx)
@@ -581,14 +618,14 @@ def equi_join(
     iota_r = jnp.arange(nr, dtype=jnp.int32)
     bh_sorted, perm_b = jax.lax.sort([bh, iota_r], num_keys=1)
 
-    lo = jnp.searchsorted(bh_sorted, ph, side="left")
-    hi = jnp.searchsorted(bh_sorted, ph, side="right")
+    lo = searchsorted_tpu(bh_sorted, ph, side="left")
+    hi = searchsorted_tpu(bh_sorted, ph, side="right")
     counts = (hi - lo).astype(jnp.int64)
     cum = jnp.cumsum(counts)
     total = cum[-1]
 
     j = jnp.arange(C, dtype=jnp.int64)
-    pidx = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    pidx = searchsorted_tpu(cum, j, side="right").astype(jnp.int32)
     pidx_c = jnp.minimum(pidx, nl - 1)
     start = jnp.take(cum, pidx_c) - jnp.take(counts, pidx_c)
     k = j - start
@@ -906,7 +943,7 @@ def unnest_expand(
     total = ends[-1] if n else jnp.int64(0)
     starts = ends - row_lens
     j = jnp.arange(C, dtype=jnp.int64)
-    src = jnp.searchsorted(ends, j, side="right")
+    src = searchsorted_tpu(ends, j, side="right")
     src_c = jnp.clip(src, 0, max(n - 1, 0)).astype(jnp.int32)
     pos = j - jnp.take(starts, src_c)
     out_live = j < total

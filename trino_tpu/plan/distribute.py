@@ -28,8 +28,8 @@ from ..connectors.spi import CatalogManager
 from ..data.types import BIGINT, DOUBLE
 from .ir import Call, Const, FieldRef, IrExpr
 from .nodes import (
-    AggCall, Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit,
-    PlanNode, Project, Sort, TableScan, TopN, Values, Window,
+    AggCall, Aggregate, Concat, Distinct, EnforceSingleRow, Exchange, Filter,
+    Join, Limit, PlanNode, Project, Sort, TableScan, TopN, Values, Window,
 )
 
 __all__ = ["distribute"]
@@ -109,6 +109,15 @@ class _Distributor:
         if isinstance(node, Filter):
             child, part = self.visit(node.child)
             return Filter(child, node.predicate), part
+
+        if isinstance(node, EnforceSingleRow):
+            # the at-most-one-row check must see ALL rows once: gather
+            # partitioned input (a per-device count would under-report)
+            child, part = self.visit(node.child)
+            if part.kind != "replicated":
+                child = Exchange(child, "gather")
+                part = _Part("replicated")
+            return EnforceSingleRow(child), part
 
         if isinstance(node, Project):
             child, part = self.visit(node.child)

@@ -22,7 +22,7 @@ from .ir import IrExpr
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
     "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
-    "Exchange", "Unnest",
+    "Exchange", "Unnest", "EnforceSingleRow",
 ]
 
 
@@ -236,6 +236,28 @@ class Limit(PlanNode):
 class Distinct(PlanNode):
     """SELECT DISTINCT (reference: AggregationNode with no aggregates /
     MarkDistinct family)."""
+
+    child: PlanNode
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class EnforceSingleRow(PlanNode):
+    """Runtime guard that its input has at most one row — the scalar-subquery
+    contract (reference: EnforceSingleRowOperator).  The traced program
+    reports the live-row count through the overflow vector; the host raises
+    when it exceeds 1 (kernels cannot raise)."""
 
     child: PlanNode
 

@@ -23,9 +23,9 @@ from typing import Optional
 
 from .ir import FieldRef, IrExpr, field_refs, remap
 from .nodes import (
-    Aggregate, AggCall, Concat, Distinct, Filter, Join, Limit, PlanNode,
-    Project, Sort, SortKey, TableScan, TopN, Unnest, Values, Window,
-    WindowCall,
+    Aggregate, AggCall, Concat, Distinct, EnforceSingleRow, Filter, Join,
+    Limit, PlanNode, Project, Sort, SortKey, TableScan, TopN, Unnest, Values,
+    Window, WindowCall,
 )
 
 __all__ = ["optimize", "prune_columns"]
@@ -245,6 +245,10 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         # DISTINCT is defined over its full input schema: keep everything
         child, m = _prune(node.child, set(range(len(node.child.output_types))))
         return Distinct(child), m
+
+    if isinstance(node, EnforceSingleRow):
+        child, m = _prune(node.child, needed)
+        return EnforceSingleRow(child), m
 
     if isinstance(node, Values):
         return node, {i: i for i in range(len(node.types))}

@@ -1195,7 +1195,33 @@ class Planner:
         inner, correlated = self._split_correlated(q, outer_scope, ctes)
         agg_calls = self._collect_aggs(sel, ())
         if not agg_calls or sel.group_by:
-            raise PlanningError("scalar subquery must be a single ungrouped aggregate")
+            if correlated:
+                raise PlanningError(
+                    "correlated scalar subquery must be a single ungrouped aggregate"
+                )
+            # uncorrelated arbitrary scalar subquery (SELECT DISTINCT x ...,
+            # grouped selects, ...): plan the whole query and broadcast its
+            # single row through a cross join (reference:
+            # EnforceSingleRowOperator; TPC-DS q06's d_month_seq lookup)
+            sub = self._plan_subquery_relation(q, outer_scope, ctes)
+            if len(sub.fields) != 1:
+                raise PlanningError("scalar subquery must select one expression")
+            from .nodes import EnforceSingleRow
+
+            node = Join("cross", rel.node, EnforceSingleRow(sub.node), (), (), None)
+            new_fields = rel.fields + [Field(None, None, sub.fields[0].type)]
+            joined = RelationPlan(node, new_fields)
+            op_t = _Translator(joined.scope, outer, agg_map=translator.agg_map)
+            lhs = op_t.translate(operand_ast)
+            rhs = FieldRef(len(new_fields) - 1, sub.fields[0].type)
+            pred = _cmp(cmp_op, lhs, rhs)
+            filtered = Filter(joined.node, pred)
+            proj_back = Project(
+                filtered,
+                tuple(FieldRef(i, rel.fields[i].type) for i in range(len(rel.fields))),
+                tuple(f.name or f"_c{i}" for i, f in enumerate(rel.fields)),
+            )
+            return RelationPlan(proj_back, rel.fields)
 
         # correlation equalities -> inner group keys
         outer_t = _Translator(rel.scope, outer)

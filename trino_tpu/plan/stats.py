@@ -140,6 +140,12 @@ def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:
         child = estimate(node.child, catalogs)
         return PlanStats(float(min(node.count, child.rows)), child.columns)
 
+    from .nodes import EnforceSingleRow
+
+    if isinstance(node, EnforceSingleRow):
+        child = estimate(node.child, catalogs)
+        return PlanStats(1.0, child.columns)
+
     if isinstance(node, Values):
         return PlanStats(float(len(node.rows)), {})
 

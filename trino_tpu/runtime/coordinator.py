@@ -38,6 +38,7 @@ from ..plan.optimizer import optimize
 from ..plan.planner import Planner
 from ..plan.serde import _encode, plan_to_json
 from .session import SessionProperties
+from .spool import SPOOL_URL, SpooledExchange
 from .statemachine import QueryStateMachine
 from .wire import wire_to_page
 
@@ -279,17 +280,26 @@ class Coordinator:
                 consumer_of[child] = f.id
 
         phased = self.session.get("retry_policy") == "TASK"
+        # durable spooled exchange (reference: ExchangeManager SPI): finished
+        # task output commits to this directory; a dead producer's committed
+        # output is re-read instead of recomputed, and workers hold no
+        # finished chunks in RAM
+        spool_dir = self.session.get("exchange_spool_dir") or ""
+        spool = SpooledExchange(spool_dir) if (spool_dir and phased) else None
         task_urls: dict[int, list[tuple[str, str]]] = {}  # frag -> [(url, task_id)]
         frag_meta: dict[int, tuple[dict, str]] = {}  # frag -> (payload_base, tag)
         all_tasks: list[tuple[str, str]] = []
         heal_seq = [0]
 
         def heal(fid: int) -> bool:
-            """Re-run fragment `fid`'s tasks whose workers died, children
-            first (a dead worker loses its buffered stage outputs, so the
-            deterministic task is recomputed on a live node — the FTE
-            scheduler's recovery, possible here because phased mode keeps
-            every completed stage's chunks un-acked on its worker).
+            """Recover fragment `fid`'s tasks whose workers died, children
+            first.  With the spooled exchange configured, a dead producer
+            whose output COMMITTED is simply re-pointed at the spool — its
+            committed chunks are RE-READ, nothing recomputes (reference:
+            FileSystemExchangeSource).  Only an uncommitted task (died
+            mid-run) is recomputed on a live node.  Without a spool, phased
+            mode keeps every completed stage's chunks un-acked on its
+            worker, and a dead worker forces deterministic recompute.
             Returns True if any task moved."""
             f = frag_by_id[fid]
             moved = False
@@ -298,8 +308,16 @@ class Coordinator:
             urls_list = task_urls.get(fid)
             if urls_list is None:
                 return moved
-            dead = [i for i, (u, _) in enumerate(urls_list) if not self._worker_alive(u)]
+            dead = [
+                i
+                for i, (u, _) in enumerate(urls_list)
+                if u != SPOOL_URL and not self._worker_alive(u)
+            ]
             for i in dead:
+                if spool is not None and spool.is_committed(urls_list[i][1]):
+                    urls_list[i] = (SPOOL_URL, urls_list[i][1])
+                    moved = True
+                    continue
                 heal_seq[0] += 1
                 alive = [
                     w for w in self.alive_workers() if w != urls_list[i][0]
@@ -343,6 +361,7 @@ class Coordinator:
                     # re-scheduled consumers must re-read sources from token
                     # 0, so TASK retry keeps producer chunks un-acked
                     "ack_sources": not phased,
+                    "exchange_dir": spool_dir if spool is not None else None,
                 }
                 tag = f"{sm.query_id}_a{attempt}_f{f.id}"
                 frag_meta[f.id] = (payload_base, tag)
@@ -383,19 +402,25 @@ class Coordinator:
             for child_id in root.inputs:
                 child = frag_by_id[child_id]
                 blobs: list[bytes] = []
+                def fetch_one(u: str, t: str) -> list[bytes]:
+                    if u == SPOOL_URL:
+                        return spool.read_chunks(t, 0)
+                    return _stream_fetch(u, t, 0)
+
                 for i in range(len(task_urls[child_id])):
                     u, t = task_urls[child_id][i]
                     try:
-                        blobs.extend(_stream_fetch(u, t, 0))
+                        blobs.extend(fetch_one(u, t))
                     except Exception as e:
                         if not phased:
                             raise RuntimeError(self._failure_detail(all_tasks, e))
                         # producer died between finishing and our fetch:
-                        # recompute it (and anything it lost) and re-read
+                        # re-read from the spool (or recompute it and
+                        # anything it lost when nothing committed)
                         heal(child_id)
                         u, t = task_urls[child_id][i]
                         try:
-                            blobs.extend(_stream_fetch(u, t, 0))
+                            blobs.extend(fetch_one(u, t))
                         except Exception as e2:
                             raise RuntimeError(self._failure_detail(all_tasks, e2))
                 remote_pages[child_id] = wire_to_page(
@@ -406,6 +431,8 @@ class Coordinator:
             record["result"] = page.to_pylist()
         finally:
             self._cleanup_tasks(all_tasks)
+            if spool is not None:  # committed stage output dies with the query
+                spool.remove_query(sm.query_id)
 
     def _run_stage_phased(
         self,
@@ -521,6 +548,8 @@ class Coordinator:
 
     def _cleanup_tasks(self, all_tasks) -> None:
         for (u, t) in all_tasks:
+            if u == SPOOL_URL:
+                continue
             try:
                 req = urllib.request.Request(f"{u}/v1/task/{t}", method="DELETE")
                 with urllib.request.urlopen(req, timeout=5) as r:

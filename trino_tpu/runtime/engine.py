@@ -133,24 +133,66 @@ class Engine:
         with self.tracer.span("execute"):
             return self._execute_planned(plan)
 
-    def _execute_planned(self, plan) -> Page:
+    def _device_memory_budget(self) -> int:
+        """Per-query device-memory budget: the session property when set,
+        else (0 = auto) ~80% of the accelerator's reported HBM — the
+        reactive-spill trigger needs no session hint.  -1 disables the
+        budget entirely (never reroute out-of-core); returns 0 when no
+        budget applies."""
         budget = int(self.session.get("query_max_memory_bytes") or 0)
+        if budget == -1:
+            return 0
+        if budget:
+            return budget
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats() or {}
+            lim = int(stats.get("bytes_limit") or 0)
+            return int(lim * 0.8)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def _is_device_oom(e: Exception) -> bool:
+        s = str(e)
+        return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
+
+    def _run_out_of_core(self, plan, est: int, budget: int) -> Page:
+        from ..exec.spill import OutOfCoreExecutor
+
+        parts = max(2, min(16, -(-est // max(budget, 1))))
+        parts = 1 << (parts - 1).bit_length()  # pow2 slices, capped:
+        # beyond 16 the per-slice compile overhead dominates any
+        # memory win (deeper budgets should spill to bigger disks,
+        # not thinner slices)
+        ooc = OutOfCoreExecutor(
+            self.catalogs, self.default_catalog, parts, self.session
+        )
+        self.last_spill = ooc  # observable: spilled_bytes/spill_files
+        return ooc.execute(plan)
+
+    def _execute_planned(self, plan) -> Page:
+        budget = self._device_memory_budget()
         if budget and not self.distributed:
-            from ..exec.spill import OutOfCoreExecutor, estimate_plan_bytes
-            from .memory import MemoryExceeded
+            from ..exec.spill import estimate_plan_bytes
 
             est = estimate_plan_bytes(plan, self.catalogs)
             if est > budget:
-                parts = max(2, min(16, -(-est // budget)))
-                parts = 1 << (parts - 1).bit_length()  # pow2 slices, capped:
-                # beyond 16 the per-slice compile overhead dominates any
-                # memory win (deeper budgets should spill to bigger disks,
-                # not thinner slices)
-                ooc = OutOfCoreExecutor(
-                    self.catalogs, self.default_catalog, parts, self.session
+                return self._run_out_of_core(plan, est, budget)
+            try:
+                return self.executor.execute(plan)
+            except Exception as e:
+                if not self._is_device_oom(e):
+                    raise
+                # REACTIVE spill (reference: revocable memory +
+                # SpillableHashAggregationBuilder): the pre-plan estimate
+                # admitted the query but actual state (join blowup, capacity
+                # growth) exceeded HBM — rerun partitioned, sizing P from
+                # the observed shortfall rather than the scan estimate
+                return self._run_out_of_core(
+                    plan, max(est, budget) * 2, budget
                 )
-                self.last_spill = ooc  # observable: spilled_bytes/spill_files
-                return ooc.execute(plan)
         return self.executor.execute(plan)
 
     def query(self, sql) -> list[tuple]:

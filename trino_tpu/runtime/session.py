@@ -68,12 +68,24 @@ PROPERTIES: dict[str, _Prop] = {
             None,
         ),
         _Prop(
+            "exchange_spool_dir", str, "",
+            "directory for the durable spooled exchange (reference: "
+            "spi/exchange/ExchangeManager SPI + trino-exchange-filesystem). "
+            "When set with retry_policy=TASK, every finished task's output "
+            "buffers are committed there; a dead producer's output is "
+            "RE-READ from the spool instead of recomputed, and workers "
+            "drop spooled chunks from RAM",
+            None,
+        ),
+        _Prop(
             "query_max_memory_bytes", int, 0,
-            "device-memory budget per query; 0 = unlimited.  Queries whose "
-            "estimated working set exceeds it run out-of-core: partitioned "
+            "device-memory budget per query; 0 = auto (~80% of the "
+            "accelerator's reported HBM), -1 = unlimited (never reroute). "
+            "Queries whose estimated working set exceeds the budget — or "
+            "that hit device OOM mid-run — run out-of-core: partitioned "
             "into sequential slices with disk-spilled exchanges "
             "(exec/spill.py; reference: spiller/ + revocable memory)",
-            lambda v: v >= 0,
+            lambda v: v >= -1,
         ),
     ]
 }

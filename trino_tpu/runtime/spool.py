@@ -1,0 +1,93 @@
+"""Spooled durable exchange + output-buffer spill (the FTE foundation).
+
+Reference mechanisms this replaces, TPU-runtime-shaped:
+
+- `spi/exchange/ExchangeManager.java:39` and
+  `plugin/trino-exchange-filesystem/FileSystemExchangeManager.java` — under
+  fault-tolerant (TASK-retry) execution every stage's output is written to
+  durable storage, so a failed/killed producer's committed output is
+  RE-READ by consumers instead of recursively recomputed, and repeated
+  attempts of a deterministic task commit byte-identical output (the
+  exactly-once attempt selection collapses to "first COMMIT wins").
+- `execution/buffer/OutputBufferMemoryManager` — un-acknowledged output
+  chunks parked on a worker are bounded: past the byte budget they live on
+  disk (the chunks are already zstd-framed by the C++ serde,
+  native/pageserde.cpp, so spooling is a plain byte write) and are served
+  back by file read on fetch.
+
+Commit protocol: chunks are written under
+    {dir}/{task_id}/buf{buffer}/{token:06d}.bin
+then an empty `COMMITTED` marker lands last.  Readers treat a task dir
+without the marker as absent — a crashed producer can never expose a
+partial buffer (the reference's sink commit handshake,
+FileSystemExchangeSink.finish).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+__all__ = ["SpooledExchange", "SPOOL_URL"]
+
+# sentinel "worker url" marking a source served from the spool, not HTTP
+SPOOL_URL = "spool"
+
+_MARKER = "COMMITTED"
+
+
+class SpooledExchange:
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------- producer
+    def commit_task(self, task_id: str, buffers: dict[int, list[bytes]]) -> None:
+        """Write every buffer's chunks, marker last (crash-atomic commit)."""
+        tdir = os.path.join(self.dir, task_id)
+        os.makedirs(tdir, exist_ok=True)
+        for buffer_id, chunks in buffers.items():
+            bdir = os.path.join(tdir, f"buf{buffer_id}")
+            os.makedirs(bdir, exist_ok=True)
+            for token, blob in enumerate(chunks):
+                with open(os.path.join(bdir, f"{token:06d}.bin"), "wb") as f:
+                    f.write(blob)
+        with open(os.path.join(tdir, _MARKER), "wb"):
+            pass
+
+    # ------------------------------------------------------------- consumer
+    def is_committed(self, task_id: str) -> bool:
+        return os.path.exists(os.path.join(self.dir, task_id, _MARKER))
+
+    def chunk_path(self, task_id: str, buffer_id: int, token: int) -> str:
+        return os.path.join(
+            self.dir, task_id, f"buf{buffer_id}", f"{token:06d}.bin"
+        )
+
+    def read_chunks(self, task_id: str, buffer_id: int) -> list[bytes]:
+        """All chunks of one committed buffer, token order."""
+        if not self.is_committed(task_id):
+            raise FileNotFoundError(f"task {task_id} not committed in spool")
+        bdir = os.path.join(self.dir, task_id, f"buf{buffer_id}")
+        if not os.path.isdir(bdir):
+            return []
+        out = []
+        for name in sorted(os.listdir(bdir)):
+            if name.endswith(".bin"):
+                with open(os.path.join(bdir, name), "rb") as f:
+                    out.append(f.read())
+        return out
+
+    # -------------------------------------------------------------- cleanup
+    def remove_query(self, query_prefix: str) -> None:
+        """Drop every committed task dir of one query (task ids are
+        `{query_id}_...`-prefixed) — the coordinator calls this when the
+        query reaches a terminal state."""
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name.startswith(query_prefix):
+                shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)

@@ -33,6 +33,7 @@ bounded by one chunk per producer even when the exchange moves gigabytes.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import traceback
 import urllib.request
@@ -44,25 +45,32 @@ from ..connectors.spi import CatalogManager
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
+from .spool import SPOOL_URL, SpooledExchange
 from .wire import page_to_wire_chunks, partition_page, wire_to_page
 
 __all__ = ["Worker"]
 
 
 class _Task:
-    """One task's lifecycle + output buffers (reference: SqlTask.java:498)."""
+    """One task's lifecycle + output buffers (reference: SqlTask.java:498).
+
+    A buffer entry is one of: bytes (RAM-resident chunk), a str file path
+    (chunk spooled/spilled to disk — read back on fetch), or None
+    (acknowledged and freed).  Only bytes entries count against the
+    worker's buffered_bytes — bounding worker memory is the point of the
+    file form (reference: OutputBufferMemoryManager)."""
 
     def __init__(self, task_id: str):
         self.task_id = task_id
         self.state = "RUNNING"
         self.error: Optional[str] = None
-        # buffer_id -> list of chunks (None = acknowledged/freed)
-        self.buffers: dict[int, list[Optional[bytes]]] = {}
+        # buffer_id -> list of entries (bytes | path str | None)
+        self.buffers: dict[int, list] = {}
         self.complete = False  # all output chunks present
         self.canceled = False
         self.cond = threading.Condition()
 
-    def finish(self, buffers: dict[int, list[bytes]]) -> None:
+    def finish(self, buffers: dict[int, list]) -> None:
         with self.cond:
             self.buffers = {k: list(v) for k, v in buffers.items()}
             self.complete = True
@@ -83,11 +91,28 @@ class Worker:
         default_catalog: str,
         port: int = 0,
         task_concurrency: int = 4,
+        buffer_memory_bytes: Optional[int] = None,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.tasks: dict[str, _Task] = {}
         self.injected_failures: set[str] = set()
+        # output-buffer memory bound (reference: OutputBufferMemoryManager):
+        # finished chunks past this byte budget spill to a local directory
+        # and are served back by file read.  The dir is created eagerly (a
+        # lazy init would race across concurrent task threads) and placement
+        # is serialized so the budget check-and-admit is atomic.
+        self.buffer_memory_bytes = buffer_memory_bytes
+        if buffer_memory_bytes is not None:
+            import tempfile
+
+            self._spill_dir: Optional[str] = tempfile.mkdtemp(
+                prefix="trino_tpu_spill_"
+            )
+        else:
+            self._spill_dir = None
+        self._place_lock = threading.Lock()
+        self.spilled_chunks = 0  # observability
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=task_concurrency)
         handler = _make_handler(self)
@@ -97,16 +122,46 @@ class Worker:
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     def buffered_bytes(self) -> int:
-        """Un-acknowledged output bytes parked on this worker (the number the
-        reference's OutputBufferMemoryManager bounds)."""
+        """Un-acknowledged output bytes parked in THIS worker's RAM (the
+        number the reference's OutputBufferMemoryManager bounds); chunks
+        spooled/spilled to disk do not count — that is the point."""
         with self._lock:
             tasks = list(self.tasks.values())
         total = 0
         for t in tasks:
             with t.cond:
                 for chunks in t.buffers.values():
-                    total += sum(len(c) for c in chunks if c is not None)
+                    total += sum(
+                        len(c) for c in chunks if isinstance(c, (bytes, bytearray))
+                    )
         return total
+
+    def _finish_placed(self, task: _Task, buffers: dict[int, list[bytes]]) -> None:
+        """Place chunks (RAM up to the byte budget, disk past it) and publish
+        them — check-admit-publish holds one lock, so concurrent finishing
+        tasks cannot each read a stale buffered_bytes and overcommit."""
+        if self.buffer_memory_bytes is None:
+            task.finish(buffers)
+            return
+        with self._place_lock:  # budget check-and-admit-publish is atomic
+            used = self.buffered_bytes()
+            out: dict[int, list] = {}
+            for p, chunks in buffers.items():
+                entries: list = []
+                for i, blob in enumerate(chunks):
+                    if used + len(blob) <= self.buffer_memory_bytes:
+                        entries.append(blob)
+                        used += len(blob)
+                    else:
+                        path = os.path.join(
+                            self._spill_dir, f"{task.task_id}_b{p}_t{i}.bin"
+                        )
+                        with open(path, "wb") as f:
+                            f.write(blob)
+                        self.spilled_chunks += 1
+                        entries.append(path)
+                out[p] = entries
+            task.finish(out)
 
     def start(self) -> "Worker":
         self._thread.start()
@@ -117,6 +172,10 @@ class Worker:
         self.httpd.server_close()  # close the listening socket: connection
         # attempts fail fast instead of hanging in the kernel accept queue
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._spill_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     # ------------------------------------------------------- task execution
     def submit_task(self, req: dict) -> _Task:
@@ -159,7 +218,13 @@ class Worker:
                     for (u, t) in src["tasks"]:
                         if task.canceled:
                             raise RuntimeError("task canceled")
-                        blobs.extend(_stream_fetch(u, t, buffer_id, ack=ack))
+                        if u == SPOOL_URL:
+                            # producer is gone; its committed output lives in
+                            # the durable exchange (re-read, not recompute)
+                            spool = SpooledExchange(req["exchange_dir"])
+                            blobs.extend(spool.read_chunks(t, buffer_id))
+                        else:
+                            blobs.extend(_stream_fetch(u, t, buffer_id, ack=ack))
                 from ..data.types import parse_type
 
                 types = [parse_type(t) for t in src["types"]]
@@ -181,9 +246,28 @@ class Worker:
 
                 keys = [_decode(k) for k in req["output_keys"]]
                 chunk_lists = partition_page(page, keys, out_parts)
-                task.finish({p: chunks for p, chunks in enumerate(chunk_lists)})
+                buffers = {p: chunks for p, chunks in enumerate(chunk_lists)}
             else:  # gather / broadcast / single / result
-                task.finish({0: page_to_wire_chunks(page)})
+                buffers = {0: page_to_wire_chunks(page)}
+
+            exchange_dir = req.get("exchange_dir")
+            if exchange_dir:
+                # durable spooled exchange: commit to storage FIRST, then
+                # serve every chunk from the spool files — worker RAM holds
+                # no finished output (bounded memory + dead-producer re-read)
+                spool = SpooledExchange(exchange_dir)
+                spool.commit_task(task.task_id, buffers)
+                task.finish(
+                    {
+                        p: [
+                            spool.chunk_path(task.task_id, p, i)
+                            for i in range(len(chunks))
+                        ]
+                        for p, chunks in buffers.items()
+                    }
+                )
+            else:
+                self._finish_placed(task, buffers)
         except Exception as e:
             traceback.print_exc()
             task.fail(str(e))
@@ -206,6 +290,12 @@ class Worker:
                     blob = chunks[token]
                     if blob is None:
                         return 410, b"chunk acknowledged and freed", {}
+                    if isinstance(blob, str):  # spooled/spilled: read back
+                        try:
+                            with open(blob, "rb") as f:
+                                blob = f.read()
+                        except OSError:
+                            return 410, b"spooled chunk removed", {}
                     last = task.complete and token == len(chunks) - 1
                     return 200, blob, {"X-Complete": "1" if last else "0"}
                 if task.complete:
@@ -225,6 +315,14 @@ class Worker:
             chunks = task.buffers.get(buffer_id)
             if chunks is not None:
                 for i in range(min(token, len(chunks))):
+                    entry = chunks[i]
+                    if isinstance(entry, str) and self._is_local_spill(entry):
+                        # local spill files free with the ack; durable
+                        # exchange files outlive the task (retry re-reads)
+                        try:
+                            os.unlink(entry)
+                        except OSError:
+                            pass
                     chunks[i] = None
 
     def task_status(self, task_id: str, wait: float) -> dict:
@@ -237,12 +335,22 @@ class Worker:
                 task.cond.wait(timeout=wait)
             return {"state": task.state, "error": task.error}
 
+    def _is_local_spill(self, path: str) -> bool:
+        return self._spill_dir is not None and path.startswith(self._spill_dir)
+
     def delete_task(self, task_id: str) -> None:
         with self._lock:
             task = self.tasks.pop(task_id, None)
         if task is not None:
             task.canceled = True
             with task.cond:
+                for chunks in task.buffers.values():
+                    for entry in chunks:
+                        if isinstance(entry, str) and self._is_local_spill(entry):
+                            try:
+                                os.unlink(entry)
+                            except OSError:
+                                pass
                 task.buffers = {}
 
 

@@ -368,6 +368,19 @@ class _Parser:
                 self.expect_op(")")
                 alias = self._optional_alias()
                 return SubqueryRelation(q, alias)
+            if self.peek_op("("):
+                # ambiguous: "((select ...) intersect ...)" is a query
+                # expression, "((t join u) join v)" a join chain — try the
+                # query parse and backtrack (TPC-DS q38's FROM shape)
+                save = self.i
+                try:
+                    q = self.parse_query()
+                    self.expect_op(")")
+                except SqlSyntaxError:
+                    self.i = save
+                else:
+                    alias = self._optional_alias()
+                    return SubqueryRelation(q, alias)
             rel = self.parse_join_chain()
             self.expect_op(")")
             return rel

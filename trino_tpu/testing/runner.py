@@ -27,11 +27,13 @@ class DistributedQueryRunner:
         num_workers: int = 2,
         default_catalog: str = "tpch",
         heartbeat_interval: float = 2.0,
+        worker_buffer_memory_bytes: Optional[int] = None,
     ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
         self.num_workers = num_workers
         self.heartbeat_interval = heartbeat_interval
+        self.worker_buffer_memory_bytes = worker_buffer_memory_bytes
         self.coordinator: Optional[Coordinator] = None
         self.workers: list[Worker] = []
 
@@ -45,7 +47,11 @@ class DistributedQueryRunner:
             heartbeat_interval=self.heartbeat_interval,
         ).start()
         for _ in range(self.num_workers):
-            w = Worker(self.catalogs, self.default_catalog).start()
+            w = Worker(
+                self.catalogs,
+                self.default_catalog,
+                buffer_memory_bytes=self.worker_buffer_memory_bytes,
+            ).start()
             self.workers.append(w)
             # announce over the wire like a real worker would
             req = urllib.request.Request(
