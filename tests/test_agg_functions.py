@@ -113,3 +113,66 @@ def test_distributed_new_aggs():
     # keyless raw-only aggregate gathers then aggregates once
     # (nearest-rank: sorted [1,2,3,4,5,10,20,30], index round(0.5*7) == 4)
     assert eng.execute("select approx_percentile(x, 0.5) from t") == [(5.0,)]
+
+
+def test_approx_distinct_hll_accuracy_at_scale():
+    """approx_distinct is a real HyperLogLog sketch (constant state per
+    group): at 50k distinct values the estimate lands within the ~1.6%
+    standard error band (we assert 5%), and per-group estimates track each
+    group's true cardinality."""
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.runtime.engine import Engine
+
+    rng = np.random.default_rng(11)
+    n = 200_000
+    conn = MemoryConnector()
+    conn.create_table("big", [ColumnSchema("g", BIGINT), ColumnSchema("x", BIGINT)])
+    g = rng.integers(0, 2, n).astype(np.int64)
+    # group 0: ~50k distinct, group 1: ~500 distinct
+    x = np.where(g == 0, rng.integers(0, 50_000, n), rng.integers(0, 500, n))
+    conn.insert("big", {"g": g, "x": x.astype(np.int64)})
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", conn)
+    rows = eng.query(
+        "select g, approx_distinct(x) as ad from big group by g order by g"
+    )
+    true0 = len(np.unique(x[g == 0]))
+    true1 = len(np.unique(x[g == 1]))
+    (g0, ad0), (g1, ad1) = rows
+    assert abs(ad0 - true0) / true0 < 0.05, (ad0, true0)
+    assert abs(ad1 - true1) / true1 < 0.05, (ad1, true1)
+
+
+def test_approx_distinct_distributed_matches_local():
+    """SPMD approx_distinct repartitions RAW rows on the group keys (an HLL
+    of per-worker estimates would be garbage); the distributed estimate
+    must equal the local one exactly (same sketch over the same rows)."""
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.runtime.engine import Engine
+
+    rng = np.random.default_rng(5)
+    n = 40_000
+    g = rng.integers(0, 3, n).astype(np.int64)
+    x = rng.integers(0, 8000, n).astype(np.int64)
+    conn = MemoryConnector()
+    conn.create_table("d", [ColumnSchema("g", BIGINT), ColumnSchema("x", BIGINT)])
+    conn.insert("d", {"g": g, "x": x})
+    sql = "select g, approx_distinct(x) as ad from d group by g order by g"
+    local = Engine(default_catalog="mem")
+    local.register_catalog("mem", conn)
+    dist = Engine(default_catalog="mem", distributed=True)
+    dist.register_catalog("mem", conn)
+    got_local = local.query(sql)
+    got_dist = dist.query(sql)
+    assert got_local == got_dist, (got_local, got_dist)
+    for gv, ad in got_local:
+        true = len(np.unique(x[g == gv]))
+        assert abs(ad - true) / true < 0.05, (gv, ad, true)

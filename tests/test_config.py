@@ -1,0 +1,84 @@
+"""Config-file deployment surface (runtime/config.py + trino_tpu.server):
+etc/config.properties + etc/catalog/*.properties boot a coordinator/worker
+pair the way the reference's airlift bootstrap + CatalogManager do."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+def test_load_properties_and_catalogs(tmp_path):
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "coordinator=true\n"
+        "# a comment\n"
+        "http-server.http.port=0\n"
+        "retry-policy=TASK\n"
+        "exchange.spool-dir=/tmp/spool_x\n"
+        "memory.heap-headroom-per-node=123456\n"
+    )
+    (etc / "catalog" / "tiny.properties").write_text(
+        "connector.name=tpch\ntpch.scale=0.01\n"
+    )
+    (etc / "catalog" / "mem.properties").write_text("connector.name=memory\n")
+
+    from trino_tpu.runtime.config import load_catalogs, load_node_config
+
+    cfg = load_node_config(str(etc))
+    assert cfg.coordinator and cfg.retry_policy == "TASK"
+    assert cfg.exchange_spool_dir == "/tmp/spool_x"
+    assert cfg.cluster_memory_limit_bytes == 123456
+    catalogs = load_catalogs(str(etc))
+    assert sorted(catalogs.names()) == ["mem", "tiny"]
+    assert catalogs.get("tiny").table_schema("region") is not None
+
+
+def test_server_boot_coordinator_and_worker(tmp_path):
+    """Boot a coordinator and a worker purely from etc/ files (in-process —
+    the launcher's wiring, not its sleep loop) and run a query through the
+    wire protocol."""
+    etc_c = tmp_path / "coord" / "etc"
+    (etc_c / "catalog").mkdir(parents=True)
+    (etc_c / "config.properties").write_text("coordinator=true\n")
+    (etc_c / "catalog" / "tpch.properties").write_text(
+        "connector.name=tpch\ntpch.scale=0.01\n"
+    )
+
+    from trino_tpu.runtime.config import load_catalogs, load_node_config
+    from trino_tpu.runtime.coordinator import Coordinator
+    from trino_tpu.runtime.worker import Worker
+
+    cfg = load_node_config(str(etc_c))
+    catalogs = load_catalogs(str(etc_c))
+    coord = Coordinator(catalogs, "tpch", port=cfg.port).start()
+    try:
+        etc_w = tmp_path / "worker" / "etc"
+        (etc_w / "catalog").mkdir(parents=True)
+        (etc_w / "config.properties").write_text(
+            f"coordinator=false\ndiscovery.uri={coord.url}\ntask.concurrency=2\n"
+        )
+        (etc_w / "catalog" / "tpch.properties").write_text(
+            "connector.name=tpch\ntpch.scale=0.01\n"
+        )
+        wcfg = load_node_config(str(etc_w))
+        assert not wcfg.coordinator and wcfg.task_concurrency == 2
+        worker = Worker(
+            load_catalogs(str(etc_w)), "tpch", task_concurrency=wcfg.task_concurrency
+        ).start()
+        try:
+            req = urllib.request.Request(
+                f"{wcfg.discovery_uri}/v1/announce",
+                data=json.dumps({"url": worker.url}).encode(),
+            )
+            urllib.request.urlopen(req, timeout=10).read()
+            rows = coord.execute_query("select count(*) from region")
+            assert rows == [(5,)]
+        finally:
+            worker.stop()
+    finally:
+        coord.stop()

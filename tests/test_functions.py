@@ -196,3 +196,25 @@ def test_json_path_strictness(engine):
             "select json_extract(j, '$.b[*]') from"
             " (select '{}' as j from nation limit 1)"
         )
+
+
+def test_table_function_sequence():
+    """FROM TABLE(sequence(...)) — the polymorphic table-function surface
+    (reference: spi/function/table/, LeafTableFunctionOperator); positional
+    and named (=>) arguments."""
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", MemoryConnector())
+    assert eng.query(
+        "SELECT sum(sequential_number) AS s FROM TABLE(sequence(1, 100))"
+    ) == [(5050,)]
+    assert eng.query(
+        "SELECT count(*) FROM TABLE(sequence(start => 0, stop => 20, step => 5))"
+    ) == [(5,)]
+    # joins like any relation
+    assert eng.query(
+        "SELECT count(*) FROM TABLE(sequence(1, 10)) a"
+        " JOIN TABLE(sequence(1, 20)) b ON a.sequential_number = b.sequential_number"
+    ) == [(10,)]

@@ -133,3 +133,121 @@ def test_admission_and_cancel_via_coordinator(tpch_tiny):
         assert "resource_groups" in info
     finally:
         runner.stop()
+
+
+def test_weighted_fair_sibling_scheduling():
+    """Siblings drain in weighted-fair share order: group a (weight 3) gets
+    ~3x the admissions of group b (weight 1) while the shared parent slot
+    pool is contended (reference: resourcegroups/WeightedFairQueue.java)."""
+    from trino_tpu.runtime.resourcegroups import (
+        ResourceGroupConfig, ResourceGroupManager,
+    )
+
+    root = ResourceGroupConfig(
+        "global", max_concurrency=4, max_queued=100,
+        subgroups=(
+            ResourceGroupConfig("a", max_concurrency=4, scheduling_weight=3),
+            ResourceGroupConfig("b", max_concurrency=4, scheduling_weight=1),
+        ),
+    )
+    mgr = ResourceGroupManager(root)
+    admitted: list[str] = []
+
+    def starter(name):
+        return lambda: admitted.append(name)
+
+    # fill the parent with 4 running, queue 8 more per group
+    for i in range(4):
+        mgr.submit("a" if i % 2 == 0 else "b", f"seed{i}", 0, starter("seed"))
+    for i in range(8):
+        mgr.submit("a", f"a{i}", 0, starter("a"))
+        mgr.submit("b", f"b{i}", 0, starter("b"))
+    admitted.clear()
+    # finish the seeds: each release triggers weighted-fair draining
+    for i in range(4):
+        mgr.finish(f"seed{i}")
+    # drain everything by finishing whatever got admitted, in order
+    done = set()
+    queue_ids = [f"a{i}" for i in range(8)] + [f"b{i}" for i in range(8)]
+    # keep finishing admitted queries until all drained
+    for _ in range(40):
+        for q in queue_ids:
+            g = mgr._group_of.get(q)
+            if g is not None and q in g.running and q not in done:
+                done.add(q)
+                mgr.finish(q)
+    first8 = admitted[:8]
+    a_share = sum(1 for x in first8 if x == "a")
+    # weight 3:1 -> a should take ~6 of the first 8 admissions
+    assert a_share >= 5, (a_share, admitted)
+
+
+def test_cluster_memory_kill_biggest_query(tpch_tiny):
+    """Cluster memory enforcement: when worker-reported buffered bytes
+    exceed the cluster limit, the coordinator kills the query holding the
+    most (reference: ClusterMemoryManager + TotalReservation LowMemoryKiller)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.testing import DistributedQueryRunner
+
+    class GatedMemoryConnector(MemoryConnector):
+        def __init__(self):
+            super().__init__()
+            self.gate = threading.Event()
+            self.gated_table = None
+            self.entered = 0
+            self._elock = threading.Lock()
+
+        def read_split(self, split, columns):
+            if split.table == self.gated_table:
+                with self._elock:
+                    self.entered += 1
+                assert self.gate.wait(timeout=60), "gate never opened"
+            return super().read_split(split, columns)
+
+    conn = GatedMemoryConnector()
+    conn.create_table("build", [ColumnSchema("k", BIGINT), ColumnSchema("w", BIGINT)])
+    conn.insert("build", {"k": np.arange(500, dtype=np.int64),
+                          "w": np.arange(500, dtype=np.int64)})
+    conn.create_table("probe", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)])
+    conn.insert("probe", {"k": np.arange(1000, dtype=np.int64) % 500,
+                          "v": np.arange(1000, dtype=np.int64)})
+
+    runner = DistributedQueryRunner(
+        num_workers=2, default_catalog="memory", heartbeat_interval=0.2,
+        cluster_memory_limit_bytes=64,  # below the build stage's output
+    )
+    runner.register_catalog("memory", conn)
+    runner.start()
+    try:
+        runner.coordinator.session.set("retry_policy", "TASK")
+        conn.gated_table = "probe"
+        qid = runner.coordinator.submit_query(
+            "select sum(v + w) from probe, build where probe.k = build.k"
+        )
+        deadline = time.monotonic() + 60
+        while conn.entered == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert conn.entered > 0
+        # build output is buffered un-acked on workers; the heartbeat sweep
+        # must mark the query for death
+        deadline = time.monotonic() + 30
+        while runner.coordinator.memory_kills == 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert runner.coordinator.memory_kills > 0, "no memory kill happened"
+        conn.gate.set()
+        sm = runner.coordinator.queries[qid]["sm"]
+        deadline = time.monotonic() + 60
+        while sm.state not in ("FINISHED", "FAILED") and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert sm.state == "FAILED", sm.state
+        assert "cluster memory limit" in (sm.error or ""), sm.error
+    finally:
+        conn.gate.set()
+        runner.stop()

@@ -36,27 +36,54 @@ _SCALE = 0.01
 # Queries chosen to cover: dict-coded group-by (q01), filter boundaries on
 # DECIMAL columns + global agg (q06 — exact only because money columns are
 # scaled-int64 decimals; f32 "doubles" cannot hold the 0.06+0.01 boundary),
-# joins + high-cardinality group-by + topn (q03), semi-join (q04), exact
-# integer aggregation (the count/sum columns of q01).
-_TPU_QUERIES = ["q01", "q06", "q03"]  # q04-class semi-joins are covered on
-# the CPU tier; each extra query here costs ~3min of on-chip compiles
+# joins + high-cardinality group-by + topn (q03), large-state group-by +
+# having-subquery (q18), semi-join via EXISTS (q04), window functions
+# (w01), and the SPMD shard_map path on the chip itself (q03_dist runs
+# through Engine(distributed=True) over a 1-device mesh — collectives
+# compile and execute on hardware).  The persistent compile cache keeps
+# repeat runs to seconds.
+_TPU_QUERIES = ["q01", "q06", "q03", "q18", "q04", "w01"]
+_TPU_DISTRIBUTED = ["q03"]  # run again through shard_map on the chip
+
+# window-function coverage (TPC-H itself has no OVER clauses)
+_EXTRA_SQL = {
+    "w01": """
+        select l_orderkey, l_linenumber,
+               sum(l_quantity) over (partition by l_orderkey) as oq,
+               row_number() over (partition by l_orderkey
+                                  order by l_linenumber) as rn
+        from lineitem
+        where l_orderkey < 200
+        order by l_orderkey, l_linenumber
+    """,
+}
 
 _RUNNER = r"""
 import json, os, sys
 sys.path.insert(0, {repo!r})
 import jax
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join({repo!r}, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 from trino_tpu.connectors.tpch import TpchConnector
 from trino_tpu.runtime.engine import Engine
 
 assert jax.default_backend() != "cpu", f"expected hardware, got {{jax.default_backend()}}"
 from tests.tpch_queries import QUERIES
 
+sqls = dict(QUERIES)
+sqls.update({extra!r})
 eng = Engine()
 eng.register_catalog("tpch", TpchConnector({scale}))
 out = {{}}
 for name in {names!r}:
-    rows = eng.query(QUERIES[name])
+    rows = eng.query(sqls[name])
     out[name] = [list(r) for r in rows]
+deng = Engine(distributed=True)
+deng.register_catalog("tpch", TpchConnector({scale}))
+for name in {dist_names!r}:
+    rows = deng.query(sqls[name])
+    out[name + "_dist"] = [list(r) for r in rows]
 print("\nRESULT:" + json.dumps(out))
 """
 
@@ -72,14 +99,17 @@ def tpu_results():
         env["JAX_PLATFORMS"] = _HW
     env.pop("XLA_FLAGS", None)  # drop the CPU suite's virtual-device forcing
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    code = _RUNNER.format(repo=repo, scale=_SCALE, names=_TPU_QUERIES)
+    code = _RUNNER.format(
+        repo=repo, scale=_SCALE, names=_TPU_QUERIES,
+        dist_names=_TPU_DISTRIBUTED, extra=_EXTRA_SQL,
+    )
     proc = subprocess.run(
         [sys.executable, "-c", code],
         env=env,
         cwd=repo,
         capture_output=True,
         text=True,
-        timeout=1200,
+        timeout=3600,
     )
     if proc.returncode != 0:
         pytest.skip(f"TPU subprocess failed (hardware unavailable?):\n{proc.stderr[-2000:]}")
@@ -88,13 +118,17 @@ def tpu_results():
     return json.loads(payload[-1][len("RESULT:"):])
 
 
-@pytest.mark.parametrize("name", _TPU_QUERIES)
+@pytest.mark.parametrize(
+    "name", _TPU_QUERIES + [q + "_dist" for q in _TPU_DISTRIBUTED]
+)
 def test_tpch_on_tpu(name, tpu_results, oracle):
+    base = name[: -len("_dist")] if name.endswith("_dist") else name
     got = [tuple(r) for r in tpu_results[name]]
-    want = oracle.query(QUERIES[name])
+    want = oracle.query(_EXTRA_SQL.get(base) or QUERIES[base])
     from tests.tpch_queries import ORDERED
 
-    assert_rows_equal(got, want, ordered=name in ORDERED, rtol=1e-6)
+    ordered = ORDERED.get(base, True) if base not in _EXTRA_SQL else True
+    assert_rows_equal(got, want, ordered=ordered, rtol=1e-6)
 
 
 def test_integer_results_exact_on_tpu(tpu_results, oracle):

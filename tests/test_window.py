@@ -40,6 +40,22 @@ WINDOW_QUERIES = {
         select o_orderkey, sum(o_totalprice) over () as grand_total
         from orders where o_orderkey < 200
     """,
+    # RANGE offsets order by a NUMERIC column: sqlite holds our DATE columns
+    # as TEXT, so its value-distance arithmetic over dates cannot oracle
+    "range_offset_frame": """
+        select o_custkey, o_orderkey,
+          sum(o_totalprice) over (partition by o_custkey order by o_totalprice
+                                  range between 20000 preceding and 20000 following) as s20k,
+          count(*) over (partition by o_custkey order by o_totalprice
+                         range between 50000 preceding and current row) as c50k
+        from orders where o_custkey < 60
+    """,
+    "range_offset_desc": """
+        select o_custkey, o_orderkey,
+          count(*) over (partition by o_custkey order by o_totalprice desc
+                         range between 30000 preceding and 30000 following) as c30k
+        from orders where o_custkey < 40
+    """,
     "lag_lead": """
         select o_custkey, o_orderkey,
           lag(o_orderkey) over (partition by o_custkey order by o_orderdate, o_orderkey) as prev_k,

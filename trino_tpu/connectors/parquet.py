@@ -65,11 +65,23 @@ def _arrow_to_type(t) -> Type:
     if pa.types.is_timestamp(t):
         return TIMESTAMP
     if pa.types.is_decimal(t):
-        if t.precision > 18:
-            raise NotImplementedError("decimal precision > 18")
-        return DecimalType(t.precision, t.scale)
+        return DecimalType(min(t.precision, 38), t.scale)
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return VARCHAR
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        from ..data.types import ArrayType
+
+        return ArrayType(_arrow_to_type(t.value_type))
+    if pa.types.is_map(t):
+        from ..data.types import MapType
+
+        return MapType(_arrow_to_type(t.key_type), _arrow_to_type(t.item_type))
+    if pa.types.is_struct(t):
+        from ..data.types import RowType
+
+        return RowType(
+            [(t.field(i).name, _arrow_to_type(t.field(i).type)) for i in range(t.num_fields)]
+        )
     raise NotImplementedError(f"unsupported parquet type: {t}")
 
 
@@ -275,15 +287,46 @@ def _column_to_numpy(chunked, t: Type) -> np.ndarray:
             data = np.where(null_mask, "", data)
             return np.ma.MaskedArray(data, mask=null_mask)
         return data
+    if t.is_dict_object:
+        # list/map/struct -> python objects; Column.from_numpy interns them
+        # into the dict-coded lowering (arrow maps arrive as pair lists,
+        # structs as field dicts — both canonicalize in data/page.py)
+        vals = arr.to_pylist()
+        data = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            data[i] = v if v is not None else ([] if not t.is_row else ())
+        if null_mask is not None:
+            return np.ma.MaskedArray(data, mask=null_mask)
+        return data
     if t.is_decimal:
         # decimal128 -> scaled int64 lanes: view the 16-byte little-endian
-        # unscaled ints and keep the low word (p <= 18 always fits)
+        # unscaled ints; the high word must be sign extension of the low
+        # word (values beyond int64 need the Int128 two-limb upgrade)
         try:
             raw = np.frombuffer(arr.buffers()[1], dtype=np.int64)
-            vals = raw[2 * arr.offset : 2 * (arr.offset + len(arr))][0::2].copy()
+            window = raw[2 * arr.offset : 2 * (arr.offset + len(arr))]
+            vals = window[0::2].copy()
+            his = window[1::2]
+            ok = his == (vals >> 63)  # sign-extension check
+            if null_mask is not None:
+                ok = ok | null_mask
+            if not bool(np.all(ok)):
+                raise NotImplementedError(
+                    f"decimal({t.precision},{t.scale}) value exceeds int64 lanes"
+                )
+        except NotImplementedError:
+            raise
         except Exception:
+            pys = arr.to_pylist()
+            for v in pys:
+                if v is not None and not (
+                    -(2**63) <= int(v.scaleb(t.scale)) < 2**63
+                ):
+                    raise NotImplementedError(
+                        f"decimal({t.precision},{t.scale}) value exceeds int64 lanes"
+                    )
             vals = np.asarray(
-                [0 if v is None else int(v.scaleb(t.scale)) for v in arr.to_pylist()],
+                [0 if v is None else int(v.scaleb(t.scale)) for v in pys],
                 dtype=np.int64,
             )
         if null_mask is not None:

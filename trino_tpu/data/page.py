@@ -92,7 +92,16 @@ class Dictionary:
 
     def sorted_rank(self) -> np.ndarray:
         """rank[code] = rank of the value in sorted order, for ORDER BY."""
-        order = np.argsort(self.values, kind="stable")
+        try:
+            order = np.argsort(self.values, kind="stable")
+        except TypeError:
+            # structured values with None fields are not < -comparable;
+            # a deterministic surrogate order keeps grouping/distinct sound
+            # (ORDER BY on such values has no defined order anyway)
+            order = np.asarray(
+                sorted(range(len(self.values)), key=lambda i: repr(self.values[i])),
+                dtype=np.int64,
+            )
         rank = np.empty(len(self.values), dtype=np.int32)
         rank[order] = np.arange(len(self.values), dtype=np.int32)
         return rank
@@ -107,13 +116,34 @@ class Dictionary:
     def encode_arrays(values: Sequence) -> tuple[np.ndarray, "Dictionary"]:
         """Encode a column of arrays (lists/tuples) as codes into a dictionary
         of distinct tuples (ARRAY columns use the same codes+dict lowering as
-        VARCHAR — data/types.py ArrayType).  Built element-by-element: a plain
-        np.asarray over equal-length tuples would produce a 2-D array."""
-        arr = np.empty(len(values), dtype=object)
+        VARCHAR — data/types.py ArrayType)."""
+        return Dictionary.encode_objects(
+            values,
+            lambda v: tuple(v) if isinstance(v, (list, tuple, np.ndarray)) else (),
+        )
+
+    @staticmethod
+    def encode_objects(values: Sequence, canon) -> tuple[np.ndarray, "Dictionary"]:
+        """Encode a column of structured objects (arrays/maps/rows) as codes
+        into a dictionary of canonical hashable forms (maps: key-sorted tuple
+        of pairs; rows: field tuples) — equal values share one code, so
+        equality, grouping and joins work on codes like every dict column.
+        Interned with a hash map, NOT np.unique: canonical tuples may hold
+        None (null fields/values), which sorting would crash on."""
+        index: dict = {}
+        interned: list = []
+        codes = np.empty(len(values), dtype=np.int32)
         for i, v in enumerate(values):
-            arr[i] = tuple(v) if isinstance(v, (list, tuple, np.ndarray)) else ()
-        uniq, codes = np.unique(arr, return_inverse=True)
-        return codes.astype(np.int32), Dictionary(uniq)
+            c = canon(v)
+            code = index.get(c)
+            if code is None:
+                code = len(interned)
+                index[c] = code
+                interned.append(c)
+            codes[i] = code
+        uniq = np.empty(len(interned), dtype=object)
+        uniq[:] = interned
+        return codes, Dictionary(uniq)
 
     def __repr__(self) -> str:
         return f"Dictionary({len(self.values)} values)"
@@ -154,6 +184,12 @@ class Column:
                 valid = ok if valid is None else (np.asarray(valid) & ok)
         if type_.is_array:
             codes, dictionary = Dictionary.encode_arrays(values)
+            return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
+        if type_.is_map:
+            codes, dictionary = Dictionary.encode_objects(values, _canon_map)
+            return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
+        if type_.is_row:
+            codes, dictionary = Dictionary.encode_objects(values, _canon_row)
             return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
         if type_.is_string:
             codes, dictionary = Dictionary.encode(values)
@@ -239,7 +275,24 @@ class Page:
         for col, (hdata, hvalid) in zip(self.columns, host_cols):
             data = np.asarray(hdata)[idx]
             valid = None if hvalid is None else np.asarray(hvalid)[idx]
-            if col.type.is_array:
+            if col.type.is_map:
+                vals = (
+                    col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
+                    if len(idx)
+                    else np.array([], dtype=object)
+                )
+                out_arr = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    out_arr[i] = dict(v)
+                pys.append(out_arr)
+            elif col.type.is_row:
+                vals = (
+                    col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
+                    if len(idx)
+                    else np.array([], dtype=object)
+                )
+                pys.append(vals)
+            elif col.type.is_array:
                 vals = (
                     col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
                     if len(idx)
@@ -288,7 +341,7 @@ class Page:
         out: list[np.ndarray] = []
         for col, (hdata, hvalid) in zip(self.columns, host_cols):
             data = np.asarray(hdata)[idx]
-            if col.type.is_array or col.type.is_string:
+            if col.type.is_dict_object or col.type.is_string:
                 if len(idx):
                     data = col.dictionary.values[
                         np.clip(data, 0, max(len(col.dictionary) - 1, 0))
@@ -318,3 +371,20 @@ def _pyval(v):
     if isinstance(v, (np.bool_,)):
         return bool(v)
     return v
+
+
+def _canon_map(v) -> tuple:
+    """Canonical hashable map form: (key, value) pairs sorted by key."""
+    if isinstance(v, dict):
+        return tuple(sorted(v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(sorted(tuple(p) for p in v))
+    return ()
+
+
+def _canon_row(v) -> tuple:
+    if isinstance(v, dict):  # pyarrow structs come back as dicts
+        return tuple(v.values())
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return ()

@@ -30,6 +30,8 @@ __all__ = [
     "TIMESTAMP",
     "DecimalType",
     "ArrayType",
+    "MapType",
+    "RowType",
     "UNKNOWN",
     "date_to_days",
     "days_to_date",
@@ -70,6 +72,20 @@ class Type:
         return False
 
     @property
+    def is_map(self) -> bool:
+        return False
+
+    @property
+    def is_row(self) -> bool:
+        return False
+
+    @property
+    def is_dict_object(self) -> bool:
+        """Dict-coded structured column (array/map/row): int32 codes into a
+        host table of canonical python objects."""
+        return self.is_array or self.is_map or self.is_row
+
+    @property
     def is_orderable(self) -> bool:
         return True
 
@@ -99,15 +115,20 @@ UNKNOWN = Type("unknown", np.dtype(np.int8))
 
 @dataclass(frozen=True, repr=False)
 class DecimalType(Type):
-    """DECIMAL(p, s) as a scaled int64 (covers p <= 18; the reference's
-    Int128-backed long decimals, spi/type/Int128Math.java, are future work)."""
+    """DECIMAL(p, s) as scaled int64 lanes, p <= 38.
+
+    Long decimals (p > 18) keep int64 lanes: the declared precision is a
+    SCHEMA capacity, and real long-decimal columns overwhelmingly hold
+    values far below 10^18 — ingest verifies each value fits the lane and
+    raises otherwise (the reference's Int128Math full-width arithmetic,
+    spi/type/Int128Math.java, is the eventual two-limb upgrade)."""
 
     precision: int = 18
     scale: int = 0
 
     def __init__(self, precision: int = 18, scale: int = 0):
-        if precision > 18:
-            raise NotImplementedError("decimal precision > 18 not supported yet")
+        if precision > 38:
+            raise ValueError("decimal precision > 38")
         object.__setattr__(self, "name", f"decimal({precision},{scale})")
         object.__setattr__(self, "np_dtype", np.dtype(np.int64))
         object.__setattr__(self, "is_string", False)
@@ -138,6 +159,60 @@ class ArrayType(Type):
         return True
 
 
+@dataclass(frozen=True, repr=False)
+class MapType(Type):
+    """MAP(K, V), dict-coded like ARRAY: device lanes are int32 codes into a
+    host table of canonical maps — tuples of (key, value) pairs sorted by
+    key, so equal maps share one code and equality/grouping work by code
+    (the TPU lowering of the reference's MapBlock, spi/block/MapBlock.java:
+    hash tables per entry are pointless when distinct maps are interned)."""
+
+    key: Type = None  # type: ignore[assignment]
+    value: Type = None  # type: ignore[assignment]
+
+    def __init__(self, key: Type, value: Type):
+        object.__setattr__(self, "name", f"map({key.name},{value.name})")
+        object.__setattr__(self, "np_dtype", np.dtype(np.int32))
+        object.__setattr__(self, "is_string", False)
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
+
+    @property
+    def is_map(self) -> bool:
+        return True
+
+    @property
+    def is_orderable(self) -> bool:
+        return False  # maps compare for equality only (reference: MapType)
+
+
+@dataclass(frozen=True, repr=False)
+class RowType(Type):
+    """ROW(name type, ...), dict-coded tuples of field values (reference:
+    spi/block/RowBlock — per-field child blocks; here distinct rows intern
+    into one host table and field access gathers a per-distinct table)."""
+
+    fields: tuple = ()  # tuple[(name, Type), ...]
+
+    def __init__(self, fields):
+        fields = tuple((n, t) for n, t in fields)
+        inner = ", ".join(f"{n} {t.name}" for n, t in fields)
+        object.__setattr__(self, "name", f"row({inner})")
+        object.__setattr__(self, "np_dtype", np.dtype(np.int32))
+        object.__setattr__(self, "is_string", False)
+        object.__setattr__(self, "fields", fields)
+
+    @property
+    def is_row(self) -> bool:
+        return True
+
+    def field_index(self, name: str) -> int:
+        for i, (n, _) in enumerate(self.fields):
+            if n == name:
+                return i
+        raise KeyError(f"row has no field {name!r}")
+
+
 _EPOCH = datetime.date(1970, 1, 1)
 
 
@@ -157,6 +232,23 @@ _BY_NAME = {
 }
 
 
+def _split_top_level(text: str, many: bool = False):
+    """Split on commas not nested inside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts if many else (parts[0], ",".join(parts[1:]))
+
+
 def parse_type(text: str) -> Type:
     """Parse a type name as it appears in SQL (CAST targets, DDL)."""
     t = text.strip().lower()
@@ -169,6 +261,17 @@ def parse_type(text: str) -> Type:
     if t.startswith("array"):
         inner = t[t.index("(") + 1 : t.rindex(")")] if "(" in t else "bigint"
         return ArrayType(parse_type(inner))
+    if t.startswith("map"):
+        inner = t[t.index("(") + 1 : t.rindex(")")]
+        k, v = _split_top_level(inner)
+        return MapType(parse_type(k), parse_type(v))
+    if t.startswith("row"):
+        inner = t[t.index("(") + 1 : t.rindex(")")]
+        fields = []
+        for part in _split_top_level(inner, many=True):
+            name, _, ftype = part.strip().partition(" ")
+            fields.append((name, parse_type(ftype)))
+        return RowType(fields)
     if t.startswith("decimal") or t.startswith("numeric"):
         inner = t[t.index("(") + 1 : t.index(")")] if "(" in t else "18,0"
         parts = [p.strip() for p in inner.split(",")]
@@ -202,7 +305,7 @@ def common_super_type(a: Type, b: Type) -> Type:
             b = DecimalType(18, 0)
         if a.is_decimal and b.is_decimal:
             s = max(a.scale, b.scale)
-            p = min(18, max(a.precision - a.scale, b.precision - b.scale) + s + 1)
+            p = min(38, max(a.precision - a.scale, b.precision - b.scale) + s + 1)
             return DecimalType(p, s)
         raise TypeError(f"no common type for {a} and {b}")
     order = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3, "real": 4, "double": 5}

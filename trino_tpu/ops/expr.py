@@ -416,6 +416,14 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
               "json_size"):
         return _json_fn(op, e, args, n)
 
+    # ---- maps / rows (host maps over the dictionary of distinct values) ---
+    if op in ("map_element_at", "map_keys", "map_values") or (
+        op == "cardinality" and e.args[0].type.is_map
+    ):
+        return _map_fn(op, e, args, n)
+    if op == "row_field":
+        return _row_field(e, args, n)
+
     # ---- arrays (host maps over the dictionary of distinct arrays) --------
     if op in ("cardinality", "element_at", "contains", "array_position",
               "array_distinct", "array_sort", "array_join", "array_min",
@@ -533,6 +541,82 @@ def _obj_array(items) -> np.ndarray:
     for i, v in enumerate(items):
         out[i] = v
     return out
+
+
+def _dict_object_out(values, base: ColumnVal, out_type) -> ColumnVal:
+    """Re-encode per-distinct host results as a new dict column gathered by
+    the base column's codes."""
+    uniq, remap = np.unique(_obj_array(values), return_inverse=True)
+    codes = jnp.take(jnp.asarray(remap.astype(np.int32)), base.data)
+    return ColumnVal(codes, base.valid, Dictionary(uniq), out_type)
+
+
+def _map_fn(op: str, e: Call, args: list[ColumnVal], n: int) -> ColumnVal:
+    """Map functions over dict-coded MAP columns (canonical form: key-sorted
+    tuple of (k, v) pairs) — the per-distinct-value host strategy (reference:
+    MapBlock + scalar map functions, operator/scalar/MapKeys etc.)."""
+    m = args[0]
+    vals = m.dict.values  # object array of pair-tuples
+    if op == "cardinality":
+        table = jnp.asarray(np.asarray([len(v) for v in vals], dtype=np.int64))
+        return ColumnVal(jnp.take(table, m.data), m.valid, None, e.type)
+    if op in ("map_keys", "map_values"):
+        ix = 0 if op == "map_keys" else 1
+        return _dict_object_out(
+            [tuple(p[ix] for p in v) for v in vals], m, e.type
+        )
+    # map_element_at: m[key]; literal keys are the common shape
+    key_ir = e.args[1]
+    assert isinstance(key_ir, Const), "map subscript key must be a literal"
+    want = key_ir.value
+    picked = [dict(v).get(want) for v in vals]
+    ok = np.asarray([p is not None for p in picked], dtype=bool)
+    vt = e.type
+    if vt.is_string:
+        uniq, remap = np.unique(
+            np.asarray([p if p is not None else "" for p in picked], dtype=object),
+            return_inverse=True,
+        )
+        codes = jnp.take(jnp.asarray(remap.astype(np.int32)), m.data)
+        okl = jnp.take(jnp.asarray(ok), m.data)
+        return ColumnVal(codes, _and_valid(m.valid, okl), Dictionary(uniq), vt)
+    table = jnp.asarray(
+        np.asarray([p if p is not None else 0 for p in picked], dtype=vt.np_dtype)
+    )
+    out = jnp.take(table, m.data)
+    okl = jnp.take(jnp.asarray(ok), m.data)
+    return ColumnVal(out, _and_valid(m.valid, okl), None, vt)
+
+
+def _row_field(e: Call, args: list[ColumnVal], n: int) -> ColumnVal:
+    """row.field access: gather a per-distinct field table by row code
+    (reference: RowBlock field blocks + DereferenceExpression)."""
+    r = args[0]
+    ix = int(e.args[1].value)  # Const field index, planner-resolved
+    vals = r.dict.values  # tuples of field values
+    ft = e.type
+    picked = [v[ix] if ix < len(v) else None for v in vals]
+    ok = np.asarray([p is not None for p in picked], dtype=bool)
+    if ft.is_string or ft.is_dict_object:
+        return _dict_object_out(
+            [p if p is not None else ("" if ft.is_string else ()) for p in picked],
+            r, ft,
+        ) if ft.is_dict_object else _dict_object_str(picked, r, ft, ok)
+    table = jnp.asarray(
+        np.asarray([p if p is not None else 0 for p in picked], dtype=ft.np_dtype)
+    )
+    okl = jnp.take(jnp.asarray(ok), r.data)
+    return ColumnVal(jnp.take(table, r.data), _and_valid(r.valid, okl), None, ft)
+
+
+def _dict_object_str(picked, base: ColumnVal, ft, ok) -> ColumnVal:
+    uniq, remap = np.unique(
+        np.asarray([p if p is not None else "" for p in picked], dtype=object),
+        return_inverse=True,
+    )
+    codes = jnp.take(jnp.asarray(remap.astype(np.int32)), base.data)
+    okl = jnp.take(jnp.asarray(ok), base.data)
+    return ColumnVal(codes, _and_valid(base.valid, okl), Dictionary(uniq), ft)
 
 
 def _array_fn(op: str, e: Call, args: list[ColumnVal], n: int) -> ColumnVal:

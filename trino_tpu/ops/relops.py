@@ -238,6 +238,9 @@ def group_aggregate(
         sorted_segments=True, boundaries=(starts, ends),
     )
     for i, (arg, spec) in enumerate(zip(agg_args, specs)):
+        if out_aggs[i] is None and spec.fn == "approx_distinct":
+            out_aggs[i] = _segment_hll(arg, perm, seg, live_s, G, n)
+            continue
         if out_aggs[i] is None:  # DISTINCT/percentile: need sorted adjacency
             if i == vs_ix[0]:
                 p, ls, sg, ng = perm, live_s, seg, new_group
@@ -262,7 +265,9 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live):
     reference's DictionaryAwarePageProjection + BigintGroupByHash fast paths
     chase (TPC-H Q1: returnflag x linestatus = 6 groups over 6B rows at
     SF1000); on TPU it turns group-by into a bandwidth-bound reduction."""
-    if any(s.distinct or s.fn == "percentile" for s in specs):
+    if any(
+        s.distinct or s.fn in ("percentile", "approx_distinct") for s in specs
+    ):
         return None
     domains = []
     for kv in key_vals:
@@ -334,7 +339,7 @@ def _fused_aggs(
 
     recipe: list = []
     for arg, spec in zip(agg_args, specs):
-        if spec.distinct or spec.fn == "percentile":
+        if spec.distinct or spec.fn in ("percentile", "approx_distinct"):
             recipe.append(None)
             continue
         if spec.fn == "count_star":
@@ -438,6 +443,105 @@ def _fused_aggs(
     return out
 
 
+_HLL_P = 12  # m = 4096 buckets: ~1.04/sqrt(m) = 1.6% standard error
+
+
+def _hll_alpha(m: int) -> float:
+    if m >= 128:
+        return 0.7213 / (1.0 + 1.079 / m)
+    return {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7)
+
+
+def _hash32(data: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer over the value bits — uniform 32-bit hash lanes.
+    Floats hash their FULL bit pattern (a f32 downcast would collide every
+    double within ~1e-7 relative, blowing the HLL error bound)."""
+    if data.dtype == jnp.float64:
+        data = jax.lax.bitcast_convert_type(data, jnp.int64)
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        data = jax.lax.bitcast_convert_type(
+            data.astype(jnp.float32), jnp.int32
+        )
+    v = data.astype(jnp.uint64) if data.dtype == jnp.int64 else data
+    if v.dtype == jnp.uint64:
+        v = (v ^ (v >> 32)).astype(jnp.uint32)
+    else:
+        v = v.astype(jnp.uint32)
+    v = v ^ (v >> 16)
+    v = v * jnp.uint32(0x85EBCA6B)
+    v = v ^ (v >> 13)
+    v = v * jnp.uint32(0xC2B2AE35)
+    v = v ^ (v >> 16)
+    return v
+
+
+def _segment_hll(
+    arg: ColumnVal,
+    perm: jnp.ndarray,
+    seg: jnp.ndarray,
+    live_s: jnp.ndarray,
+    G: int,
+    n: int,
+):
+    """Grouped HyperLogLog: approx_distinct with CONSTANT sketch state per
+    group (reference: ApproximateCountDistinctAggregations over
+    HyperLogLogType).  TPU shape: one extra sort by (group, bucket, rho)
+    puts every (group, bucket)'s MAX rho at its run end; per-group sums of
+    2^-rho then ride the same boundary-cumsum machinery as every other
+    sorted reduction — no G x m dense state ever materializes (empty
+    buckets enter the estimator arithmetically via m - nonempty)."""
+    m = 1 << _HLL_P
+    rest_bits = 32 - _HLL_P
+    data_s = jnp.take(arg.data, perm)
+    valid_s = jnp.take(_valid_of(arg, n), perm) & live_s
+    h = _hash32(data_s)
+    bucket = (h >> rest_bits).astype(jnp.int32)
+    rest = (h & jnp.uint32((1 << rest_bits) - 1)).astype(jnp.int32)
+    # rho = leading-zero count within the rest_bits window + 1
+    bitlen = jnp.where(
+        rest > 0,
+        jnp.floor(jnp.log2(jnp.maximum(rest, 1).astype(jnp.float32))).astype(jnp.int32)
+        + 1,
+        0,
+    )
+    rho = rest_bits + 1 - bitlen  # in [1, rest_bits + 1]
+    combined = seg.astype(jnp.int64) * m + bucket
+    dead_val = jnp.int64(G) * m
+    combined = jnp.where(valid_s, combined, dead_val)
+    c_s, rho_s = jax.lax.sort([combined, rho], num_keys=2)
+    # run ends carry the bucket's max rho (rho ascends within a run)
+    is_end = jnp.concatenate(
+        [c_s[1:] != c_s[:-1], jnp.ones((1,), jnp.bool_)]
+    )
+    live_end = is_end & (c_s < dead_val)
+    # keep gseg NONDECREASING (c_s is sorted): non-end rows stay in their
+    # group's run with zero contribution — masking them to G would break the
+    # boundary searchsorted's sortedness precondition
+    gseg = jnp.minimum((c_s // m).astype(jnp.int32), G)
+    contrib_z = jnp.where(live_end, 2.0 ** (-rho_s.astype(jnp.float64)), 0.0)
+    contrib_e = live_end.astype(jnp.int64)
+    # boundary-cumsum reductions apply over the sorted gseg
+    from .pallas.segreduce import SegRed, _sorted_fallback
+
+    z_part, e_cnt = _sorted_fallback(
+        gseg,
+        [SegRed("sum", contrib_z, None), SegRed("sum", contrib_e, None)],
+        G,
+    )
+    e_cnt = e_cnt.astype(jnp.float64)
+    z = (m - e_cnt) + z_part  # empty buckets contribute 2^0 each
+    estimate = _hll_alpha(m) * m * m / jnp.maximum(z, 1e-12)
+    # small-range (linear counting) correction
+    v_empty = m - e_cnt
+    small = m * jnp.log(m / jnp.maximum(v_empty, 1.0))
+    estimate = jnp.where(
+        (estimate < 2.5 * m) & (v_empty > 0), small, estimate
+    )
+    counts = jnp.round(estimate).astype(jnp.int64)
+    counts = jnp.where(e_cnt > 0, counts, 0)
+    return counts, None
+
+
 def _segment_agg(
     arg: Optional[ColumnVal],
     spec: AggSpec,
@@ -509,6 +613,12 @@ def _global_aggregate(agg_args, specs, live):
             out_aggs.append(pre)
             continue
         valid = _valid_of(arg, n) & live
+        if spec.fn == "approx_distinct":
+            seg1 = jnp.zeros((n,), jnp.int32)
+            perm1 = jnp.arange(n, dtype=jnp.int32)
+            cnts, _ = _segment_hll(arg, perm1, seg1, live, 1, n)
+            out_aggs.append((cnts, None))
+            continue
         if spec.distinct:
             k = _sortable_key(arg)
             inv_s, k_s = jax.lax.sort([(~valid).astype(jnp.int8), k], num_keys=2)

@@ -117,16 +117,45 @@ def window_eval(
     idx32 = jnp.arange(n, dtype=jnp.int32)
     part_start = _seg_scan("max", jnp.where(new_part, idx32, -1), new_part)
 
+    # first ORDER BY key in sorted (transformed) space — RANGE offset frames
+    # resolve their bounds against it
+    okey_sorted = None
+    if order_keys:
+        ok = order_keys[0]
+        okey_sorted = (
+            jnp.take(_sortable_key(ok, descending=not order_specs[0].ascending), perm),
+            jnp.take(_valid_of(ok, n), perm),
+            ok.type,
+            bool(order_specs[0].nulls_first),
+        )
+
     # ---- evaluate calls ----------------------------------------------------
     for call, argv in zip(calls, arg_vals):
         argv = [gather(a) for a in argv]
         out_cols.append(
             _eval_call(
                 call, argv, n, new_part, new_peer, part_end, peer_end,
-                row_number, live_s, part_start,
+                row_number, live_s, part_start, okey_sorted,
             )
         )
     return out_cols, live_s
+
+
+def _bounded_searchsorted(vals, target, lo0, hi0_excl, side, n):
+    """Per-row binary search restricted to [lo0, hi0_excl): first index whose
+    value >= target ('left') / > target ('right').  34 static halving steps
+    cover any n; each step is one gather — the partition-local searchsorted
+    RANGE frames need (a global searchsorted can't see partition bounds)."""
+    lo = lo0.astype(jnp.int32)
+    hi = hi0_excl.astype(jnp.int32)
+    for _ in range(34):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        vm = jnp.take(vals, jnp.clip(mid, 0, max(n - 1, 0)))
+        pred = (vm < target) if side == "left" else (vm <= target)
+        lo = jnp.where(active & pred, mid + 1, lo)
+        hi = jnp.where(active & ~pred, mid, hi)
+    return lo
 
 
 def _literal_arg(call, i: int, argv, default=None) -> int:
@@ -151,7 +180,7 @@ def _frame_bounds(frame: str):
 
 def _eval_call(
     call, argv, n, new_part, new_peer, part_end, peer_end, row_number, live_s,
-    part_start,
+    part_start, okey_sorted=None,
 ):
     from ..data.types import BIGINT
 
@@ -264,13 +293,59 @@ def _eval_call(
     # ROWS offset frames ('rows:<lo>:<hi>') use prefix DIFFERENCES for
     # sum/count/avg and shifted-lane or directional scans for min/max
     # (reference: window/FrameInfo + per-row frame walk in WindowPartition)
-    offset_frame = call.frame.startswith("rows:")
-    if offset_frame:
+    offset_frame = call.frame.startswith(("rows:", "range:"))
+    range_bounded_lo = False
+    if call.frame.startswith("rows:"):
         lo, hi = _frame_bounds(call.frame)
         i32 = jnp.arange(n, dtype=jnp.int32)
         hi_idx = part_end if hi == "u" else jnp.minimum(i32 + hi, part_end)
         lo_idx = part_start if lo == "u" else jnp.maximum(i32 + lo, part_start)
         empty = lo_idx > hi_idx
+    elif call.frame.startswith("range:"):
+        # RANGE <k> PRECEDING/FOLLOWING: bounds by ORDER BY VALUE distance.
+        # In _sortable_key-transformed space (descending already negated),
+        # both directions reduce to [v - k_pre, v + k_fol]; rows with a NULL
+        # key frame their null peer group (Trino RANGE semantics)
+        if okey_sorted is None:
+            raise NotImplementedError("RANGE offset frame requires ORDER BY")
+        lo, hi = _frame_bounds(call.frame)
+        kvals, kvalid, ktype, nulls_first = okey_sorted
+        scale = 10 ** getattr(ktype, "scale", 0) if ktype.is_decimal else 1
+        kv = kvals.astype(jnp.float64)  # exact to 2^53; lanes are ints/dates
+        # NULL-key rows' lanes hold garbage (nulls order via a separate flag
+        # operand): substitute the infinity that matches their sort position
+        # so the searched array stays sorted AND finite offsets never reach
+        # them
+        sent = -jnp.inf if nulls_first else jnp.inf
+        kv = jnp.where(kvalid, kv, sent)
+        i32 = jnp.arange(n, dtype=jnp.int32)
+        peer_start = _seg_scan(
+            "max", jnp.where(new_peer, i32, -1), new_peer
+        )
+        if lo == "u":
+            lo_idx = part_start
+        else:
+            lo_idx = _bounded_searchsorted(
+                kv, kv + float(lo) * scale, part_start, part_end + 1, "left", n
+            )
+            # NULL-key rows frame their null peer group on offset bounds
+            lo_idx = jnp.where(kvalid, lo_idx, peer_start)
+        if hi == "u":
+            hi_idx = part_end
+        else:
+            hi_idx = (
+                _bounded_searchsorted(
+                    kv, kv + float(hi) * scale, part_start, part_end + 1,
+                    "right", n,
+                )
+                - 1
+            )
+            hi_idx = jnp.where(kvalid, hi_idx, peer_end)
+        range_bounded_lo = lo != "u"
+        lo, hi = "u", "u"  # min/max below must route scans, never the roll
+        empty = lo_idx > hi_idx
+
+    if offset_frame:
 
         def frame_sum(contrib):
             running = _seg_scan("add", contrib, new_part)
@@ -340,6 +415,10 @@ def _eval_call(
         x = jnp.where(valid, a.data, sent)
         red = "min" if fn == "min" else "max"
         if offset_frame:
+            if range_bounded_lo:
+                raise NotImplementedError(
+                    "min/max over a RANGE frame with a bounded PRECEDING edge"
+                )
             c = frame_sum(valid.astype(jnp.int64))
             if lo != "u" and hi != "u":
                 width = hi - lo + 1

@@ -242,7 +242,10 @@ class _Distributor:
         # must see raw rows: repartition (or gather, keyless) then aggregate
         # once (the reference splits these via intermediate state types;
         # raw-row repartition is the simpler TPU-shaped equivalent)
-        _raw_only = {"percentile", "stddev_samp", "stddev_pop", "var_samp", "var_pop"}
+        # approx_distinct: an HLL estimate of per-worker estimates is garbage
+        # (merging would need the sketch registers, not the counts)
+        _raw_only = {"percentile", "stddev_samp", "stddev_pop", "var_samp",
+                     "var_pop", "approx_distinct"}
         has_distinct = any(a.distinct for a in node.aggs)
         if has_distinct or any(a.fn in _raw_only for a in node.aggs):
             if nk == 0:

@@ -31,12 +31,15 @@ from .nodes import (
 __all__ = ["optimize", "prune_columns"]
 
 
-def optimize(plan: PlanNode, catalogs=None) -> PlanNode:
+def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
     # push filters first: reorder's cost model reads relation stats AFTER
     # their local predicates (a filter stuck above the join region would make
     # every order look cost-equal)
     plan = push_filters(plan)
-    if catalogs is not None:
+    reorder_on = (
+        session is None or session.get("join_reordering_strategy") == "AUTOMATIC"
+    )
+    if catalogs is not None and reorder_on:
         from .reorder import reorder_joins
 
         plan = reorder_joins(plan, catalogs)

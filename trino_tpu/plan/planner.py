@@ -533,6 +533,37 @@ class Planner:
             return RelationPlan(node, left.fields)
         return RelationPlan(node, fields)
 
+    def _plan_table_function(self, r) -> RelationPlan:
+        """Built-in polymorphic table functions (reference:
+        spi/function/table/ + LeafTableFunctionOperator).  `sequence(start,
+        stop [, step])` is the canonical leaf function — args positional or
+        named (start =>, stop =>, step =>).  Lowers to UNNEST of the scalar
+        sequence() array (one interned array value, device-side expansion —
+        no per-row Values materialization in the plan)."""
+        from .nodes import Values
+
+        if r.name != "sequence":
+            raise PlanningError(f"unknown table function: {r.name}")
+        named: dict = {}
+        pos: list = []
+        for name, e in zip(r.arg_names, r.args):
+            (named.__setitem__(name, e) if name else pos.append(e))
+        start = named.get("start", pos[0] if len(pos) > 0 else A.IntLit(0))
+        stop = named.get("stop", pos[1] if len(pos) > 1 else None)
+        step = named.get("step", pos[2] if len(pos) > 2 else None)
+        if stop is None:
+            raise PlanningError("sequence() requires a stop bound")
+        fn_args = (start, stop) + ((step,) if step is not None else ())
+        unnest = A.UnnestRelation(
+            (A.FuncCall("sequence", fn_args),),
+            r.alias or "sequence",
+            ("sequential_number",),
+            False,
+        )
+        return self._plan_unnest(
+            RelationPlan(Values((), (), ((),)), []), unnest, None
+        )
+
     def _plan_relation(
         self, r: A.Relation, outer: Optional[Scope], ctes: dict[str, A.Query]
     ) -> RelationPlan:
@@ -564,6 +595,8 @@ class Planner:
             return RelationPlan(
                 sub.node, [Field(r.alias, f.name, f.type) for f in sub.fields]
             )
+        if isinstance(r, A.TableFunctionRelation):
+            return self._plan_table_function(r)
         if isinstance(r, A.UnnestRelation):
             from .nodes import Values
 
@@ -710,8 +743,12 @@ class Planner:
         # when the group expr is a bare column, so post-agg name resolution works
         fields: list[Field] = []
         for g_ast, g_ir in zip(sel.group_by, group_irs):
-            if isinstance(g_ast, A.Ident):
-                hit = rel.scope.try_resolve(g_ast.parts)
+            hit = (
+                rel.scope.try_resolve(g_ast.parts)
+                if isinstance(g_ast, A.Ident)
+                else None
+            )
+            if hit is not None:  # bare column (not e.g. a row dereference)
                 f = rel.fields[hit[1]]
                 fields.append(Field(f.qualifier, f.name, g_ir.type))
             else:
@@ -744,10 +781,12 @@ class Planner:
                 aggs.append(AggCall("sum", arg, BIGINT))
                 continue
             if name == "approx_distinct":
-                # exact distinct count satisfies any approximation contract;
-                # the sort-based group-by gives it for free (vs the
-                # reference's HLL sketches, aggregation/ApproximateCountDistinct)
-                aggs.append(AggCall("count", arg, BIGINT, distinct=True))
+                # real HyperLogLog sketch (ops/relops.py _segment_hll) — the
+                # point of approx_distinct is CONSTANT state per group at
+                # scale, which exact distinct cannot honor (reference:
+                # aggregation/ApproximateCountDistinctAggregations,
+                # spi/type/HyperLogLogType)
+                aggs.append(AggCall("approx_distinct", arg, BIGINT))
                 continue
             if name == "approx_percentile":
                 if not arg.type.is_numeric:
@@ -1326,6 +1365,21 @@ class _Translator:
             return self.agg_map[e]
         if isinstance(e, A.Ident):
             hit = self.scope.try_resolve(e.parts)
+            if hit is None and len(e.parts) >= 2:
+                # dereference: the prefix may resolve to a ROW-typed column
+                # and the last part to one of its fields (reference:
+                # DereferenceExpression -> RowBlock field access)
+                base = self.scope.try_resolve(e.parts[:-1])
+                if base is not None:
+                    depth, idx, bt = base
+                    if depth == 0 and bt.is_row:
+                        fi = bt.field_index(e.parts[-1])
+                        ftype = bt.fields[fi][1]
+                        return Call(
+                            "row_field",
+                            (FieldRef(idx, bt), Const(fi, BIGINT)),
+                            ftype,
+                        )
             if hit is None:
                 raise PlanningError(f"column not found: {e}")
             depth, idx, t = hit
@@ -1456,7 +1510,7 @@ class _Translator:
             # operand rescaling (reference: decimal operator typing)
             ta = a.type if a.type.is_decimal else DecimalType(18, 0)
             tb = b.type if b.type.is_decimal else DecimalType(18, 0)
-            out_t = DecimalType(min(18, ta.precision + tb.precision), ta.scale + tb.scale)
+            out_t = DecimalType(min(38, ta.precision + tb.precision), ta.scale + tb.scale)
             if isinstance(a, Const) and isinstance(b, Const) and a.value is not None and b.value is not None:
                 return Const(a.value * b.value, out_t)
             return Call("mul", (a, b), out_t)
@@ -1674,13 +1728,25 @@ class _Translator:
                 raise PlanningError("split requires varchar")
             return Call("split", args, ArrayType(VARCHAR))
         if name == "cardinality":
-            if not args[0].type.is_array:
-                raise PlanningError("cardinality requires an array")
+            if not (args[0].type.is_array or args[0].type.is_map):
+                raise PlanningError("cardinality requires an array or map")
             return Call("cardinality", args, BIGINT)
         if name == "element_at":
+            if args[0].type.is_map:
+                if not isinstance(args[1], Const):
+                    raise PlanningError("map subscript key must be a literal")
+                return Call("map_element_at", args, args[0].type.value)
             if not args[0].type.is_array:
-                raise PlanningError("element_at requires an array")
+                raise PlanningError("element_at requires an array or map")
             return Call("element_at", args, args[0].type.element)
+        if name == "map_keys":
+            if not args[0].type.is_map:
+                raise PlanningError("map_keys requires a map")
+            return Call("map_keys", args, ArrayType(args[0].type.key))
+        if name == "map_values":
+            if not args[0].type.is_map:
+                raise PlanningError("map_values requires a map")
+            return Call("map_values", args, ArrayType(args[0].type.value))
         if name == "contains":
             if not args[0].type.is_array:
                 raise PlanningError("contains requires an array")
@@ -1988,7 +2054,7 @@ def _agg_type(fn: str, arg_t: Type) -> Type:
         if arg_t.is_decimal:
             # widen to the max short-decimal precision (reference widens to
             # decimal(38,s); int64 lanes cap at 18)
-            return DecimalType(18, arg_t.scale)
+            return DecimalType(38, arg_t.scale)
         return DOUBLE if arg_t.is_floating else arg_t
     return arg_t  # min / max
 

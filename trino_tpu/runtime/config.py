@@ -1,0 +1,123 @@
+"""Config-file deployment surface (etc/ properties files).
+
+The reference boots from `etc/config.properties` (396 @Config setters bound
+by airlift bootstrap), catalogs from `etc/catalog/*.properties`
+(connector.name=... picks the plugin), and per-query overrides ride session
+properties.  Same shape here:
+
+    etc/
+      config.properties          node role + ports + limits
+      catalog/
+        tpch.properties          connector.name=tpch\ntpch.scale=0.01
+        lake.properties          connector.name=parquet\nparquet.root=/data
+
+Recognized config.properties keys:
+    coordinator=true|false          node role (default true)
+    http-server.http.port=8080      listen port (0 = ephemeral)
+    discovery.uri=http://host:port  coordinator URL a worker announces to
+    query.max-memory-per-node=...   bytes; becomes query_max_memory_bytes
+    memory.heap-headroom-per-node   bytes; cluster_memory_limit_bytes
+    exchange.spool-dir=/path        durable spooled exchange directory
+    retry-policy=NONE|QUERY|TASK    default retry policy
+    task.concurrency=4              worker executor pool width
+
+Connector factories (connector.name=):
+    tpch (tpch.scale=), tpcds (tpcds.scale=), memory, blackhole,
+    parquet (parquet.root=), orc (orc.root=), iceberg (iceberg.root=),
+    faker (faker.rows= faker.schema= as JSON)
+
+`python -m trino_tpu.server --etc DIR` boots the node described there
+(server/TrinoServer.java:23's role here).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["load_properties", "load_catalogs", "NodeConfig", "load_node_config"]
+
+
+def load_properties(path: str) -> dict[str, str]:
+    """Java-style .properties: key=value lines, # comments, trimmed."""
+    out: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _make_connector(props: dict[str, str]):
+    name = props.get("connector.name")
+    if name == "tpch":
+        from ..connectors.tpch import TpchConnector
+
+        return TpchConnector(float(props.get("tpch.scale", "0.01")))
+    if name == "tpcds":
+        from ..connectors.tpcds import TpcdsConnector
+
+        return TpcdsConnector(float(props.get("tpcds.scale", "0.002")))
+    if name == "memory":
+        from ..connectors.memory import MemoryConnector
+
+        return MemoryConnector()
+    if name == "blackhole":
+        from ..connectors.memory import BlackholeConnector
+
+        return BlackholeConnector()
+    if name == "parquet":
+        from ..connectors.parquet import ParquetConnector
+
+        return ParquetConnector(props["parquet.root"])
+    if name == "orc":
+        from ..connectors.orc import OrcConnector
+
+        return OrcConnector(props["orc.root"])
+    if name == "iceberg":
+        from ..connectors.iceberg import IcebergConnector
+
+        return IcebergConnector(props["iceberg.root"])
+    if name == "faker":
+        from ..connectors.faker import FakerConnector
+
+        return FakerConnector(int(props.get("faker.rows", "1000")))
+    raise ValueError(f"unknown connector.name: {name!r}")
+
+
+def load_catalogs(etc_dir: str):
+    """etc/catalog/*.properties -> CatalogManager (reference: catalog
+    properties loaded by CatalogManager at boot)."""
+    from ..connectors.spi import CatalogManager
+
+    catalogs = CatalogManager()
+    cat_dir = os.path.join(etc_dir, "catalog")
+    if os.path.isdir(cat_dir):
+        for fname in sorted(os.listdir(cat_dir)):
+            if not fname.endswith(".properties"):
+                continue
+            props = load_properties(os.path.join(cat_dir, fname))
+            catalogs.register(fname[: -len(".properties")], _make_connector(props))
+    return catalogs
+
+
+class NodeConfig:
+    def __init__(self, props: dict[str, str]):
+        self.coordinator = props.get("coordinator", "true").lower() == "true"
+        self.port = int(props.get("http-server.http.port", "0"))
+        self.discovery_uri: Optional[str] = props.get("discovery.uri")
+        self.query_max_memory_bytes = int(props.get("query.max-memory-per-node", "0"))
+        self.cluster_memory_limit_bytes = int(
+            props.get("memory.heap-headroom-per-node", "0")
+        )
+        self.exchange_spool_dir = props.get("exchange.spool-dir", "")
+        self.retry_policy = props.get("retry-policy", "NONE")
+        self.task_concurrency = int(props.get("task.concurrency", "4"))
+
+
+def load_node_config(etc_dir: str) -> NodeConfig:
+    path = os.path.join(etc_dir, "config.properties")
+    return NodeConfig(load_properties(path) if os.path.exists(path) else {})

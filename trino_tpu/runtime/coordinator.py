@@ -61,6 +61,7 @@ class Coordinator:
         port: int = 0,
         heartbeat_interval: float = 2.0,
         resource_groups=None,
+        cluster_memory_limit_bytes: int = 0,  # 0 = no enforcement
     ):
         from .resourcegroups import ResourceGroupManager
 
@@ -71,6 +72,10 @@ class Coordinator:
         self.workers: dict[str, _WorkerInfo] = {}
         self.queries: dict[str, dict] = {}
         self.resource_groups = ResourceGroupManager(resource_groups)
+        # reference: memory/ClusterMemoryManager.java:92 polls worker
+        # MemoryInfo and OOM-kills the biggest reservation under pressure
+        self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
+        self.memory_kills = 0  # observability
         self._lock = threading.Lock()
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
@@ -107,17 +112,41 @@ class Coordinator:
         while not self._hb_stop.wait(self.heartbeat_interval):
             with self._lock:
                 infos = list(self.workers.values())
+            cluster_by_query: dict[str, int] = {}
             for w in infos:
                 try:
                     with urllib.request.urlopen(f"{w.url}/v1/info", timeout=2) as r:
-                        r.read()
+                        info = json.loads(r.read())
                     w.alive = True
                     w.failures = 0
                     w.last_seen = time.time()
+                    for qid, b in (info.get("buffered_by_query") or {}).items():
+                        cluster_by_query[qid] = cluster_by_query.get(qid, 0) + int(b)
                 except Exception:
                     w.failures += 1
                     if w.failures >= 2:
                         w.alive = False
+            self._enforce_cluster_memory(cluster_by_query)
+
+    def _enforce_cluster_memory(self, by_query: dict[str, int]) -> None:
+        """Kill the biggest reservation when the cluster exceeds its memory
+        limit (reference: ClusterMemoryManager + TotalReservation
+        LowMemoryKiller).  Workers report per-query RAM-resident output
+        bytes; the query holding the most across the cluster dies first."""
+        limit = self.cluster_memory_limit_bytes
+        if not limit or sum(by_query.values()) <= limit:
+            return
+        for qid, _bytes in sorted(by_query.items(), key=lambda kv: -kv[1]):
+            record = self.queries.get(qid)
+            if record is None or record["sm"].state in ("FINISHED", "FAILED"):
+                continue
+            record["kill_reason"] = (
+                f"Query killed: cluster memory limit {limit} bytes exceeded "
+                f"(query held {_bytes} buffered bytes)"
+            )
+            record["cancel"] = True
+            self.memory_kills += 1
+            return  # one victim per sweep; re-evaluate next heartbeat
 
     # ------------------------------------------------------------ execution
     def execute_query(self, sql: str) -> list[tuple]:
@@ -264,7 +293,7 @@ class Coordinator:
             raise RuntimeError("no alive workers")
         nw = len(workers)
 
-        plan = optimize(self.planner.plan(record["sql"]), self.catalogs)
+        plan = optimize(self.planner.plan(record["sql"]), self.catalogs, self.session)
         dplan = distribute(plan, self.catalogs, nw, self.session)
         fragments = fragment_plan(dplan)
         record["columns"] = list(plan.output_names)
@@ -346,7 +375,9 @@ class Coordinator:
         try:
             for f in sorted(fragments, key=lambda f: -f.id):
                 if record.get("cancel"):
-                    raise RuntimeError("Query was canceled")
+                    raise RuntimeError(
+                        record.get("kill_reason") or "Query was canceled"
+                    )
                 if f.output_kind == "result":
                     continue  # runs on coordinator below
                 out_parts = ntasks[consumer_of[f.id]]
@@ -627,7 +658,7 @@ def _statement_surface(coord: "Coordinator"):
             self.tracer = Tracer()
 
         def plan(self, sql_or_query):
-            return optimize(self.planner.plan(sql_or_query), self.catalogs)
+            return optimize(self.planner.plan(sql_or_query), self.catalogs, self.session)
 
         def query(self, sql_or_query) -> list[tuple]:
             # unmanaged: the enclosing statement already holds the group slot

@@ -105,7 +105,7 @@ class Engine:
     def plan(self, sql_or_query) -> PlanNode:
         from ..plan.optimizer import optimize
 
-        plan = optimize(self.planner.plan(sql_or_query), self.catalogs)
+        plan = optimize(self.planner.plan(sql_or_query), self.catalogs, self.session)
         # table-level SELECT checks on the final plan: base tables of views/
         # CTEs/subqueries are all visible as scans here (reference:
         # checkCanSelectFromColumns per analyzed table reference)

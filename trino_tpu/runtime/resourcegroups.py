@@ -36,12 +36,16 @@ class ResourceGroupConfig:
         max_queued: int = 1000,
         memory_limit_bytes: int = 0,  # 0 = unlimited
         subgroups: tuple["ResourceGroupConfig", ...] = (),
+        scheduling_weight: int = 1,
     ):
         self.name = name
         self.max_concurrency = max_concurrency
         self.max_queued = max_queued
         self.memory_limit_bytes = memory_limit_bytes
         self.subgroups = subgroups
+        # weighted-fair share between sibling groups competing for a
+        # parent's slots (reference: resourcegroups/WeightedFairQueue.java)
+        self.scheduling_weight = max(1, scheduling_weight)
 
 
 class _Group:
@@ -168,19 +172,31 @@ class ResourceGroupManager:
                 del g.queue[in_queue[0]]
             else:
                 g.release(qid, mem)
-            # a freed slot may unblock any group under the same ancestors:
-            # drain every admissible queue head (FIFO within each group)
-            progress = True
-            while progress:
-                progress = False
-                for grp in self._groups.values():
-                    if grp.queue and grp.can_admit(grp.queue[0][1]):
-                        nqid, nmem, nstart = grp.queue.popleft()
-                        grp.admit(nqid, nmem)
-                        self._mem_of[nqid] = nmem
-                        self._group_of[nqid] = grp
-                        to_start.append(nstart)
-                        progress = True
+            # a freed slot may unblock any group under the same ancestors.
+            # Among admissible candidates, WEIGHTED-FAIR selection: admit
+            # from the group with the smallest running/weight share first
+            # (reference: WeightedFairQueue.java — FIFO within a group,
+            # weighted shares between siblings)
+            while True:
+                candidates = [
+                    grp
+                    for grp in self._groups.values()
+                    if grp.queue and grp.can_admit(grp.queue[0][1])
+                ]
+                if not candidates:
+                    break
+                grp = min(
+                    candidates,
+                    key=lambda g: (
+                        len(g.running) / g.cfg.scheduling_weight,
+                        g.cfg.name,
+                    ),
+                )
+                nqid, nmem, nstart = grp.queue.popleft()
+                grp.admit(nqid, nmem)
+                self._mem_of[nqid] = nmem
+                self._group_of[nqid] = grp
+                to_start.append(nstart)
         for s in to_start:
             s()
 

@@ -68,6 +68,13 @@ PROPERTIES: dict[str, _Prop] = {
             None,
         ),
         _Prop(
+            "join_reordering_strategy", str, "AUTOMATIC",
+            "AUTOMATIC | NONE — cost-based join reordering over inner-equi "
+            "regions (plan/reorder.py; reference: ReorderJoins.java + the "
+            "benchto variable of the same name)",
+            lambda v: v in ("AUTOMATIC", "NONE"),
+        ),
+        _Prop(
             "exchange_spool_dir", str, "",
             "directory for the durable spooled exchange (reference: "
             "spi/exchange/ExchangeManager SPI + trino-exchange-filesystem). "

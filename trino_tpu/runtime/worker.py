@@ -125,16 +125,25 @@ class Worker:
         """Un-acknowledged output bytes parked in THIS worker's RAM (the
         number the reference's OutputBufferMemoryManager bounds); chunks
         spooled/spilled to disk do not count — that is the point."""
+        return sum(self.buffered_by_query().values())
+
+    def buffered_by_query(self) -> dict[str, int]:
+        """RAM-resident output bytes per query (task ids are query-id
+        prefixed) — the per-query reservation the coordinator's cluster
+        memory manager aggregates to pick an OOM-kill victim (reference:
+        MemoryInfo polled by ClusterMemoryManager.java:92)."""
         with self._lock:
             tasks = list(self.tasks.values())
-        total = 0
+        out: dict[str, int] = {}
         for t in tasks:
+            # "q_<12 hex>..." -> the query id; anything else groups whole
+            qid = t.task_id[:14] if t.task_id.startswith("q_") else t.task_id
             with t.cond:
                 for chunks in t.buffers.values():
-                    total += sum(
+                    out[qid] = out.get(qid, 0) + sum(
                         len(c) for c in chunks if isinstance(c, (bytes, bytearray))
                     )
-        return total
+        return out
 
     def _finish_placed(self, task: _Task, buffers: dict[int, list[bytes]]) -> None:
         """Place chunks (RAM up to the byte budget, disk past it) and publish
@@ -429,6 +438,7 @@ def _make_handler(worker: Worker):
             if parts[:2] == ["v1", "info"]:
                 import resource as _res
 
+                by_query = worker.buffered_by_query()
                 body = json.dumps(
                     {
                         "state": "active",
@@ -438,7 +448,8 @@ def _make_handler(worker: Worker):
                         # is KiB on linux
                         "rss_bytes": _res.getrusage(_res.RUSAGE_SELF).ru_maxrss
                         * 1024,
-                        "buffered_bytes": worker.buffered_bytes(),
+                        "buffered_bytes": sum(by_query.values()),
+                        "buffered_by_query": by_query,
                     }
                 ).encode()
                 return self._send(200, body, "application/json")

@@ -1,0 +1,78 @@
+"""Node launcher: `python -m trino_tpu.server --etc DIR [--default-catalog C]`.
+
+Boots a coordinator or worker from etc/ properties files (runtime/config.py)
+— the reference's TrinoServer main (core/trino-server-main/TrinoServer.java:
+23-27) with airlift bootstrap replaced by the properties loader.  A worker
+node announces itself to `discovery.uri` and serves tasks; a coordinator
+serves the client protocol (/v1/statement + nextUri) until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trino_tpu.server")
+    ap.add_argument("--etc", required=True, help="etc/ directory with config.properties + catalog/")
+    ap.add_argument("--default-catalog", default=None)
+    args = ap.parse_args(argv)
+
+    from .runtime.config import load_catalogs, load_node_config
+
+    cfg = load_node_config(args.etc)
+    catalogs = load_catalogs(args.etc)
+    names = catalogs.names()
+    default_catalog = args.default_catalog or (names[0] if names else "memory")
+
+    if cfg.coordinator:
+        from .runtime.coordinator import Coordinator
+
+        coord = Coordinator(
+            catalogs,
+            default_catalog,
+            port=cfg.port,
+            cluster_memory_limit_bytes=cfg.cluster_memory_limit_bytes,
+        ).start()
+        if cfg.query_max_memory_bytes:
+            coord.session.set("query_max_memory_bytes", str(cfg.query_max_memory_bytes))
+        if cfg.exchange_spool_dir:
+            coord.session.set("exchange_spool_dir", cfg.exchange_spool_dir)
+        if cfg.retry_policy != "NONE":
+            coord.session.set("retry_policy", cfg.retry_policy)
+        print(f"coordinator listening on {coord.url}", flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            coord.stop()
+        return 0
+
+    from .runtime.worker import Worker
+
+    worker = Worker(
+        catalogs, default_catalog, port=cfg.port,
+        task_concurrency=cfg.task_concurrency,
+    ).start()
+    print(f"worker listening on {worker.url}", flush=True)
+    if cfg.discovery_uri:
+        req = urllib.request.Request(
+            f"{cfg.discovery_uri}/v1/announce",
+            data=json.dumps({"url": worker.url}).encode(),
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+        print(f"announced to {cfg.discovery_uri}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,6 +219,18 @@ class UnnestRelation(Relation):
 
 
 @dataclass(frozen=True)
+class TableFunctionRelation(Relation):
+    """FROM TABLE(fn(arg [, arg...])) — polymorphic table function call
+    (reference: spi/function/table/, operator/LeafTableFunctionOperator).
+    Arguments may be positional or named (name => expr)."""
+
+    name: str
+    args: tuple[Expr, ...]
+    arg_names: tuple[Optional[str], ...]
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class JoinRelation(Relation):
     kind: str  # inner | left | right | full | cross
     left: Relation

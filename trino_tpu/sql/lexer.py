@@ -30,7 +30,7 @@ class Token:
 
 
 _OPERATORS = [
-    "<>", "!=", ">=", "<=", "||", "->",
+    "<>", "!=", ">=", "<=", "||", "->", "=>",
     "+", "-", "*", "/", "%", "(", ")", ",", ".", ";", "<", ">", "=", "?",
     "[", "]",
 ]

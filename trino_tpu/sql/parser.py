@@ -362,6 +362,36 @@ class _Parser:
                         break
                 self.expect_op(")")
             return UnnestRelation(tuple(exprs), alias, tuple(col_aliases), with_ord)
+        if self.accept_kw("TABLE"):
+            # TABLE(fn(args...)) — polymorphic table function invocation
+            # (reference: sql/tree/TableFunctionInvocation)
+            from .ast import TableFunctionRelation
+
+            self.expect_op("(")
+            fname = self.ident().lower()
+            self.expect_op("(")
+            args: list[Expr] = []
+            arg_names: list[Optional[str]] = []
+            if not self.peek_op(")"):
+                while True:
+                    name = None
+                    if (
+                        self.cur.kind in ("IDENT", "QIDENT")
+                        and self.tokens[self.i + 1].kind == "OP"
+                        and self.tokens[self.i + 1].value == "=>"
+                    ):
+                        name = self.ident().lower()
+                        self.i += 1  # consume =>
+                    arg_names.append(name)
+                    args.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            self.expect_op(")")
+            alias = self._optional_alias()
+            return TableFunctionRelation(
+                fname, tuple(args), tuple(arg_names), alias
+            )
         if self.accept_op("("):
             if self.peek_kw("SELECT", "WITH"):
                 q = self.parse_query()
@@ -724,6 +754,11 @@ class _Parser:
                 # general offset frame (reference: window/FrameInfo ROWS
                 # mode); encoded for the kernel's prefix-difference path
                 frame = f"rows:{lo}:{hi}"
+            elif unit == "range":
+                # value-distance frame (reference: FrameInfo RANGE mode):
+                # bounds resolve by ORDER BY value offset, per-row bounded
+                # binary search in the kernel (ops/window.py)
+                frame = f"range:{lo}:{hi}"
             else:
                 raise SqlSyntaxError(
                     f"{unit.upper()} frames with numeric offsets are not supported"

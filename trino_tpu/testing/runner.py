@@ -28,12 +28,14 @@ class DistributedQueryRunner:
         default_catalog: str = "tpch",
         heartbeat_interval: float = 2.0,
         worker_buffer_memory_bytes: Optional[int] = None,
+        cluster_memory_limit_bytes: int = 0,
     ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
         self.num_workers = num_workers
         self.heartbeat_interval = heartbeat_interval
         self.worker_buffer_memory_bytes = worker_buffer_memory_bytes
+        self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.coordinator: Optional[Coordinator] = None
         self.workers: list[Worker] = []
 
@@ -45,6 +47,7 @@ class DistributedQueryRunner:
             self.catalogs,
             self.default_catalog,
             heartbeat_interval=self.heartbeat_interval,
+            cluster_memory_limit_bytes=self.cluster_memory_limit_bytes,
         ).start()
         for _ in range(self.num_workers):
             w = Worker(
