@@ -341,7 +341,19 @@ class LocalExecutor:
                     return child_sizes[0]
                 if n.kind == "cross":
                     return child_sizes[0]
-                caps[nid] = _pow2(max(max(child_sizes), 1))
+                hard = _pow2(max(max(child_sizes), 1))
+                # stats-sized expansion frame: the join kernel's sorts,
+                # searchsorteds and column gathers all run at CAPACITY lanes,
+                # so a worst-case frame (max child capacity) made a 29k-row
+                # join cost like an 8M-row one.  2x the Selinger estimate,
+                # floored, capped by the worst case; the overflow retry loop
+                # corrects underestimates (reference: join stats sizing the
+                # hash table, JoinStatsRule + FlatHash growth)
+                hint = est_groups(n)
+                if hint is not None:
+                    caps[nid] = min(hard, _pow2(max(2 * hint, 4096)))
+                else:
+                    caps[nid] = hard
                 if n.kind == "left":
                     return caps[nid] + child_sizes[0]
                 if n.kind == "full":
@@ -468,7 +480,10 @@ def _trace_plan(
     memo: dict[PlanNode, tuple["_Stage", tuple[int, ...], int]] = {}
 
     def report(nid: int, value):
-        if axis is not None:
+        # single-device meshes skip the collective: pmax is an identity there
+        # AND some AOT backends (axon's chipless helper) lower only Sum
+        # all-reduces, so an avoidable Max all-reduce would fail to compile
+        if axis is not None and num_devices > 1:
             value = jax.lax.pmax(value, axis)
         required[nid] = value
 

@@ -147,9 +147,22 @@ class SpmdExecutor(LocalExecutor):
             if isinstance(n, Join):
                 if n.kind == "cross":
                     return child_sizes[0]
-                caps[nid] = _pow2(max(max(child_sizes), 1))
+                hard = _pow2(max(max(child_sizes), 1))
                 if n.kind in ("semi", "anti", "null_anti"):
+                    caps[nid] = hard
                     return child_sizes[0]
+                # stats-sized expansion frame per device (same rationale as
+                # LocalExecutor._initial_caps: kernel work scales with
+                # CAPACITY lanes, and worst-case frames made small joins
+                # cost like full-table ones); the retry loop corrects
+                # underestimates
+                try:
+                    from ..plan.stats import estimate as _est
+
+                    hint = int(_est(n, self.catalogs).rows * 1.3 // max(D, 1)) + 16
+                    caps[nid] = min(hard, _pow2(max(2 * hint, 4096)))
+                except Exception:
+                    caps[nid] = hard
                 if n.kind == "left":
                     return caps[nid] + child_sizes[0]
                 return caps[nid]

@@ -64,13 +64,28 @@ def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:
     if isinstance(node, Filter):
         child = estimate(node.child, catalogs)
         sel = _selectivity(node.predicate, child)
-        cols = {
-            i: ColumnStats(
-                None if c.ndv is None else max(1.0, c.ndv * sel),
-                c.min, c.max, c.null_fraction,
-            )
-            for i, c in child.columns.items()
-        }
+        # columns the predicate DIRECTLY constrains get a targeted NDV
+        # (eq -> 1, IN -> k, range -> frac * ndv — reference:
+        # FilterStatsCalculator per-domain narrowing)
+        targeted = _targeted_ndv(node.predicate, child)
+
+        def survive(ndv: Optional[float]) -> Optional[float]:
+            # distinct-value survival under row selectivity `sel` for columns
+            # the predicate does NOT directly constrain: with rows/ndv
+            # repetitions per value, P(value keeps >=1 row) =
+            # 1-(1-sel)^(rows/ndv).  Linear ndv*sel wildly UNDERestimates
+            # surviving NDV on repeated keys (fact-table FKs keep ~every
+            # key), which inflated Selinger join outputs 3-60x (the join
+            # divisor shrank) and with them the join capacity frames.
+            if ndv is None or ndv <= 0:
+                return ndv
+            reps = max(1.0, child.rows / ndv)
+            return max(1.0, ndv * (1.0 - (1.0 - min(sel, 1.0)) ** reps))
+
+        cols = {}
+        for i, c in child.columns.items():
+            nd = targeted[i] if i in targeted else survive(c.ndv)
+            cols[i] = ColumnStats(nd, c.min, c.max, c.null_fraction)
         return PlanStats(max(1.0, child.rows * sel), cols)
 
     if isinstance(node, Project):
@@ -173,6 +188,40 @@ def _expr_ndv(e: IrExpr, stats: PlanStats) -> Optional[float]:
     if isinstance(e, Const):
         return 1.0
     return None
+
+
+def _targeted_ndv(pred: IrExpr, stats: PlanStats) -> dict[int, float]:
+    """NDV of columns a top-level conjunct constrains directly:
+    eq const -> 1, IN (k values) -> k, range -> the conjunct's own
+    selectivity fraction of the column NDV."""
+    out: dict[int, float] = {}
+
+    def visit(p: IrExpr) -> None:
+        if isinstance(p, Call) and p.op == "and":
+            visit(p.args[0])
+            visit(p.args[1])
+            return
+        if isinstance(p, InListIr) and not p.negated and isinstance(
+            _uncast(p.operand), FieldRef
+        ):
+            out[_uncast(p.operand).index] = float(max(1, len(p.values)))
+            return
+        if isinstance(p, Call) and p.op in ("eq", "lt", "le", "gt", "ge"):
+            a = _uncast(p.args[0])
+            b = _uncast(p.args[1]) if len(p.args) > 1 else None
+            ref = a if isinstance(a, FieldRef) else (b if isinstance(b, FieldRef) else None)
+            const_side = b if ref is a else a
+            if ref is None or not isinstance(const_side, Const):
+                return
+            c = stats.columns.get(ref.index)
+            if p.op == "eq":
+                out[ref.index] = 1.0
+            elif c is not None and c.ndv:
+                frac = _selectivity(p, stats)
+                out[ref.index] = max(1.0, c.ndv * frac)
+
+    visit(pred)
+    return out
 
 
 def _selectivity(pred: IrExpr, stats: PlanStats) -> float:
