@@ -1,0 +1,57 @@
+"""Per-operator profile of TPC-H q18/q03 on the current default device.
+
+Uses LocalExecutor.explain_analyze's eager node hook for wall attribution
+(RTT-inflated absolutes, honest relatives), after the jitted run has learned
+capacities.  Prints one line per plan node: nid, type, ms, rows.
+"""
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from tests.tpch_queries import QUERIES
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.runtime.engine import Engine
+from trino_tpu.exec.compiler import _node_ids
+
+qname = sys.argv[1] if len(sys.argv) > 1 else "q18"
+sf = float(os.environ.get("BENCH_SF", "1"))
+
+eng = Engine()
+eng.register_catalog("tpch", TpchConnector(sf))
+plan = eng.plan(QUERIES[qname])
+
+t0 = time.perf_counter()
+eng.executor.execute(plan)
+print(f"warm (jitted) {time.perf_counter() - t0:.2f}s", flush=True)
+t0 = time.perf_counter()
+eng.executor.execute(plan)
+print(f"steady wall {time.perf_counter() - t0:.3f}s", flush=True)
+dev = eng.executor.steady_state_time(plan, iters=4)
+print(f"device steady {dev:.3f}s", flush=True)
+
+nodes = _node_ids(plan)
+t0 = time.perf_counter()
+page, stats = eng.executor.explain_analyze(plan)
+print(f"explain_analyze pass {time.perf_counter() - t0:.1f}s", flush=True)
+total = sum(s.get("ms", 0.0) for s in stats.values())
+for nid in sorted(stats, key=lambda k: -stats[k].get("ms", 0.0)):
+    s = stats[nid]
+    node = nodes.get(nid)
+    name = type(node).__name__ if node is not None else "?"
+    detail = ""
+    if node is not None and hasattr(node, "kind"):
+        detail = f"/{node.kind}"
+    print(
+        f"nid={nid:3d} {name+detail:18s} ms={s.get('ms', 0.0):9.1f} "
+        f"rows={s.get('rows', -1)}",
+        flush=True,
+    )
+print(f"total eager ms={total:.0f}  caps={eng.executor._learned_caps.get(plan)}")

@@ -80,3 +80,115 @@ def test_blackhole(engine):
     bh.insert("sink", {"x": np.arange(10)})
     assert bh.rows_swallowed == 10
     assert bh.read_split(bh.get_splits("sink", 1)[0], []) == {}
+
+
+# ------------------------------------------------------------------- views
+# reference: core/trino-parser/.../tree/CreateView.java + StatementAnalyzer
+# view expansion; VERDICT r3 missing #3
+
+
+def test_create_view_and_query(engine):
+    engine.execute("create table vt (a bigint, b varchar)")
+    engine.execute("insert into vt values (1, 'x'), (2, 'y'), (3, 'x')")
+    engine.execute("create view v1 as select b, sum(a) as s from vt group by b")
+    assert engine.execute("select * from v1 order by b") == [("x", 4), ("y", 2)]
+    # views appear in SHOW TABLES and DESCRIBE with derived types
+    assert ("v1",) in engine.execute("show tables")
+    assert engine.execute("describe v1") == [("b", "varchar"), ("s", "bigint")]
+    assert "CREATE VIEW v1 AS" in engine.execute("show create view v1")[0][0]
+
+
+def test_view_over_view_and_join(engine):
+    engine.execute("create table base (k bigint, v bigint)")
+    engine.execute("insert into base values (1, 10), (2, 20), (3, 30)")
+    engine.execute("create view even as select k, v from base where k % 2 = 0")
+    engine.execute("create view doubled as select k, v * 2 as v2 from even")
+    assert engine.execute("select k, v2 from doubled order by k") == [(2, 40)]
+    # a view joins like a table, with an alias
+    rows = engine.execute(
+        "select d.v2, b.v from doubled d join base b on d.k = b.k"
+    )
+    assert rows == [(40, 20)]
+
+
+def test_view_replace_drop_and_errors(engine):
+    engine.execute("create table rt (x bigint)")
+    engine.execute("insert into rt values (1), (2)")
+    engine.execute("create view rv as select x from rt")
+    with pytest.raises(Exception, match="already exists"):
+        engine.execute("create view rv as select x + 1 as y from rt")
+    engine.execute("create or replace view rv as select x + 1 as y from rt")
+    assert engine.execute("select * from rv order by y") == [(2,), (3,)]
+    engine.execute("drop view rv")
+    with pytest.raises(Exception):
+        engine.execute("select * from rv")
+    engine.execute("drop view if exists rv")  # no error
+    with pytest.raises(Exception, match="not found"):
+        engine.execute("drop view rv")
+
+
+def test_view_validated_at_create(engine):
+    with pytest.raises(Exception):
+        engine.execute("create view bad as select nope from missing_table")
+    # failed create leaves no trace
+    assert ("bad",) not in engine.execute("show tables")
+
+
+def test_view_cycle_detected(engine):
+    engine.execute("create table ct (x bigint)")
+    engine.execute("create view cv1 as select x from ct")
+    engine.execute("create view cv2 as select x from cv1")
+    with pytest.raises(Exception, match="cycle"):
+        engine.execute("create or replace view cv1 as select x from cv2")
+    # the failed replace must roll back to the previous definition
+    engine.execute("insert into ct values (7)")
+    assert engine.execute("select * from cv2") == [(7,)]
+
+
+def test_view_base_table_access_control(engine):
+    """SELECT on a view checks the expanded base tables (reference:
+    checkCanSelectFromColumns on the analyzed tables)."""
+    from trino_tpu.runtime.security import FileBasedAccessControl
+
+    engine.execute("create table sec (x bigint)")
+    engine.execute("insert into sec values (1)")
+    engine.execute("create view sv as select x from sec")
+    engine.access_control = FileBasedAccessControl(
+        {"tables": [{"user": "*", "table": "other", "privileges": ["SELECT"]}]}
+    )
+    try:
+        with pytest.raises(Exception):
+            engine.execute("select * from sv")
+    finally:
+        from trino_tpu.runtime.security import AllowAllAccessControl
+
+        engine.access_control = AllowAllAccessControl()
+
+
+def test_view_cannot_shadow_table(engine):
+    engine.execute("create table shadowed (x bigint)")
+    with pytest.raises(Exception, match="already exists"):
+        engine.execute("create view shadowed as select 1 as y")
+
+
+def test_view_ddl_in_rolled_back_transaction(engine):
+    engine.execute("create table txt (x bigint)")
+    engine.execute("insert into txt values (1)")
+    engine.execute("create view keepv as select x from txt")
+    engine.execute("start transaction")
+    engine.execute("create view tempv as select x + 1 as y from txt")
+    engine.execute("drop view keepv")
+    engine.execute("rollback")
+    # rolled-back view DDL leaves no trace; pre-existing view survives
+    assert ("tempv",) not in engine.execute("show tables")
+    assert engine.execute("select * from keepv") == [(1,)]
+
+
+def test_schema_qualified_view_name(engine):
+    engine.execute("create table qt (x bigint)")
+    engine.execute("insert into qt values (9)")
+    engine.execute("create view myschema.qv as select x from qt")
+    assert engine.execute("select * from myschema.qv") == [(9,)]
+    assert engine.execute("select * from qv") == [(9,)]
+    engine.execute("drop view myschema.qv")
+    assert ("qv",) not in engine.execute("show tables")

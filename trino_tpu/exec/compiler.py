@@ -70,6 +70,15 @@ def _node_ids(plan: PlanNode) -> dict[int, PlanNode]:
 # compile savings and the jitted retry loop handles growth.
 _EAGER_SIZING_LIMIT = 4_000_000
 
+# Per-connector dynamic-filter keep-mask cache size (ADVICE r3): in-process
+# multi-task runs (DistributedQueryRunner workers, TASK retries) each build a
+# fresh LocalExecutor, so without a cache the same (scan, filter-set)
+# membership test — np.isin over up to 100k values against every scan row —
+# reruns per task.  The cache dict lives ON the connector object (its
+# lifetime scopes the cache; an id()-keyed global could alias a recycled
+# address after GC) and entries key on (table, gen, split, filters).
+_KEEP_MASK_CACHE_MAX = 64
+
 
 def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
@@ -150,26 +159,33 @@ class LocalExecutor:
                 }
             if filters:
                 nrows = len(next(iter(data.values()))) if data else 0
-                keep = np.ones((nrows,), dtype=bool)
-                for f in filters:
-                    vals = data[f.column]
-                    if f.values is not None:
-                        # dictionary-set domain (string keys): membership
-                        base = (
-                            np.ma.getdata(vals)
-                            if isinstance(vals, np.ma.MaskedArray)
-                            else vals
-                        )
-                        ok = np.isin(base, np.asarray(f.values, dtype=object))
-                        if isinstance(vals, np.ma.MaskedArray):
-                            ok &= ~np.ma.getmaskarray(vals)
-                        keep &= ok
-                    elif isinstance(vals, np.ma.MaskedArray):
-                        # NULL probe keys never equi-match: prune them too
-                        ok = (vals >= f.min) & (vals <= f.max)
-                        keep &= np.asarray(ok.filled(False))
-                    else:
-                        keep &= (vals >= f.min) & (vals <= f.max)
+                cache = conn.__dict__.setdefault("_keep_mask_cache", {})
+                mask_key = (table, gen, self.split, filters)
+                keep = cache.get(mask_key)
+                if keep is None or len(keep) != nrows:
+                    keep = np.ones((nrows,), dtype=bool)
+                    for f in filters:
+                        vals = data[f.column]
+                        if f.values is not None:
+                            # dictionary-set domain (string keys): membership
+                            base = (
+                                np.ma.getdata(vals)
+                                if isinstance(vals, np.ma.MaskedArray)
+                                else vals
+                            )
+                            ok = np.isin(base, np.asarray(f.values, dtype=object))
+                            if isinstance(vals, np.ma.MaskedArray):
+                                ok &= ~np.ma.getmaskarray(vals)
+                            keep &= ok
+                        elif isinstance(vals, np.ma.MaskedArray):
+                            # NULL probe keys never equi-match: prune them too
+                            ok = (vals >= f.min) & (vals <= f.max)
+                            keep &= np.asarray(ok.filled(False))
+                        else:
+                            keep &= (vals >= f.min) & (vals <= f.max)
+                    if len(cache) >= _KEEP_MASK_CACHE_MAX:
+                        cache.clear()
+                    cache[mask_key] = keep
                 self.rows_pruned += int(nrows - keep.sum())
                 data = {c: data[c][keep] for c in missing}
             pad_to = 1  # kernels need capacity >= 1

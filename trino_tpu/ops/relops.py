@@ -452,27 +452,31 @@ def _hll_alpha(m: int) -> float:
     return {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7)
 
 
-def _hash32(data: jnp.ndarray) -> jnp.ndarray:
-    """murmur3 finalizer over the value bits — uniform 32-bit hash lanes.
-    Floats hash their FULL bit pattern (a f32 downcast would collide every
-    double within ~1e-7 relative, blowing the HLL error bound)."""
-    if data.dtype == jnp.float64:
-        data = jax.lax.bitcast_convert_type(data, jnp.int64)
-    elif jnp.issubdtype(data.dtype, jnp.floating):
-        data = jax.lax.bitcast_convert_type(
-            data.astype(jnp.float32), jnp.int32
-        )
-    v = data.astype(jnp.uint64) if data.dtype == jnp.int64 else data
-    if v.dtype == jnp.uint64:
-        v = (v ^ (v >> 32)).astype(jnp.uint32)
-    else:
-        v = v.astype(jnp.uint32)
-    v = v ^ (v >> 16)
-    v = v * jnp.uint32(0x85EBCA6B)
-    v = v ^ (v >> 13)
-    v = v * jnp.uint32(0xC2B2AE35)
-    v = v ^ (v >> 16)
-    return v
+def _hash64(data: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer over the value bits — uniform 64-bit hash lanes.
+    Floats hash their FULL f64 bit pattern (a f32 downcast would collide
+    every double within ~1e-7 relative, blowing the HLL error bound).  The
+    reference HLL also hashes 64-bit (Murmur3Hash128 in airlift stats); a
+    32-bit hash saturates its value space and biases approx_distinct low by
+    ~1% at 1e8 distinct, ~10% at 1e9 (ADVICE r3)."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        data = jax.lax.bitcast_convert_type(data.astype(jnp.float64), jnp.int64)
+    mixed = _mix64(data.astype(jnp.int64))  # uint64 lanes
+    # drop the sign bit and return int64: downstream packing (seg * m +
+    # bucket) runs in int64, and jax promotes int64 x uint64 to f64 (!)
+    return (mixed & jnp.uint64(0x7FFF_FFFF_FFFF_FFFF)).astype(jnp.int64)
+
+
+def _bitlen64(v: jnp.ndarray) -> jnp.ndarray:
+    """Bit length of non-negative int64 lanes via 6 halving steps — exact for
+    the full 63-bit range (a float log2 is only exact to the mantissa)."""
+    v = v.astype(jnp.int64)
+    bl = jnp.zeros(v.shape, jnp.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        big = (v >> s) > 0
+        bl = bl + jnp.where(big, jnp.int32(s), jnp.int32(0))
+        v = jnp.where(big, v >> s, v)
+    return bl + (v > 0).astype(jnp.int32)
 
 
 def _segment_hll(
@@ -491,20 +495,14 @@ def _segment_hll(
     sorted reduction — no G x m dense state ever materializes (empty
     buckets enter the estimator arithmetically via m - nonempty)."""
     m = 1 << _HLL_P
-    rest_bits = 32 - _HLL_P
+    rest_bits = 63 - _HLL_P  # use the hash's low 63 bits (int64 sign-safe)
     data_s = jnp.take(arg.data, perm)
     valid_s = jnp.take(_valid_of(arg, n), perm) & live_s
-    h = _hash32(data_s)
+    h = _hash64(data_s)  # int64, sign bit clear
     bucket = (h >> rest_bits).astype(jnp.int32)
-    rest = (h & jnp.uint32((1 << rest_bits) - 1)).astype(jnp.int32)
+    rest = h & jnp.int64((1 << rest_bits) - 1)
     # rho = leading-zero count within the rest_bits window + 1
-    bitlen = jnp.where(
-        rest > 0,
-        jnp.floor(jnp.log2(jnp.maximum(rest, 1).astype(jnp.float32))).astype(jnp.int32)
-        + 1,
-        0,
-    )
-    rho = rest_bits + 1 - bitlen  # in [1, rest_bits + 1]
+    rho = (rest_bits + 1 - _bitlen64(rest)).astype(jnp.int32)  # [1, 52]
     combined = seg.astype(jnp.int64) * m + bucket
     dead_val = jnp.int64(G) * m
     combined = jnp.where(valid_s, combined, dead_val)

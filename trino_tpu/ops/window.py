@@ -311,13 +311,19 @@ def _eval_call(
         lo, hi = _frame_bounds(call.frame)
         kvals, kvalid, ktype, nulls_first = okey_sorted
         scale = 10 ** getattr(ktype, "scale", 0) if ktype.is_decimal else 1
-        kv = kvals.astype(jnp.float64)  # exact to 2^53; lanes are ints/dates
         # NULL-key rows' lanes hold garbage (nulls order via a separate flag
-        # operand): substitute the infinity that matches their sort position
+        # operand): substitute the extreme that matches their sort position
         # so the searched array stays sorted AND finite offsets never reach
-        # them
-        sent = -jnp.inf if nulls_first else jnp.inf
-        kv = jnp.where(kvalid, kv, sent)
+        # them.  Integer keys (BIGINT/date/decimal lanes) stay in int64 — an
+        # f64 round-trip would mis-frame values beyond 2^53.
+        if jnp.issubdtype(kvals.dtype, jnp.integer):
+            info = jnp.iinfo(jnp.int64)
+            kv = kvals.astype(jnp.int64)
+            sent_k = jnp.int64(info.min if nulls_first else info.max)
+        else:
+            kv = kvals.astype(jnp.float64)
+            sent_k = -jnp.inf if nulls_first else jnp.inf
+        kv = jnp.where(kvalid, kv, sent_k)
         i32 = jnp.arange(n, dtype=jnp.int32)
         peer_start = _seg_scan(
             "max", jnp.where(new_peer, i32, -1), new_peer
@@ -326,7 +332,9 @@ def _eval_call(
             lo_idx = part_start
         else:
             lo_idx = _bounded_searchsorted(
-                kv, kv + float(lo) * scale, part_start, part_end + 1, "left", n
+                kv, kv + jnp.asarray(int(lo) * scale if kv.dtype == jnp.int64
+                                     else float(lo) * scale, kv.dtype),
+                part_start, part_end + 1, "left", n,
             )
             # NULL-key rows frame their null peer group on offset bounds
             lo_idx = jnp.where(kvalid, lo_idx, peer_start)
@@ -335,8 +343,9 @@ def _eval_call(
         else:
             hi_idx = (
                 _bounded_searchsorted(
-                    kv, kv + float(hi) * scale, part_start, part_end + 1,
-                    "right", n,
+                    kv, kv + jnp.asarray(int(hi) * scale if kv.dtype == jnp.int64
+                                         else float(hi) * scale, kv.dtype),
+                    part_start, part_end + 1, "right", n,
                 )
                 - 1
             )

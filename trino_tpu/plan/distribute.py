@@ -55,7 +55,7 @@ def distribute(
     """Rewrite a single-node plan into an SPMD plan for `num_devices`."""
     if num_devices <= 1:
         return plan
-    d = _Distributor(catalogs, session)
+    d = _Distributor(catalogs, session, num_devices)
     node, part = d.visit(plan)
     if part.kind != "replicated":
         node = Exchange(node, "gather")
@@ -76,9 +76,10 @@ def _re_finalize(node: PlanNode, original: PlanNode) -> PlanNode:
 
 
 class _Distributor:
-    def __init__(self, catalogs: CatalogManager, session=None):
+    def __init__(self, catalogs: CatalogManager, session=None, num_devices: int = 2):
         self.catalogs = catalogs
         self.session = session
+        self.num_devices = num_devices
 
     def _join_mode(self) -> str:
         if self.session is None:
@@ -353,9 +354,24 @@ class _Distributor:
 
         est_right = self.est_rows(node.right)
         mode = self._join_mode()
+        # Cost comparison (reference: iterative/rule/
+        # DetermineJoinDistributionType.java:51, getSourceTablesSizeInBytes):
+        # broadcast replicates the build to every device (R_bytes * D over
+        # ICI) but never moves the probe; a partitioned join all_to_all's
+        # both sides once (L_bytes + R_bytes).  AUTOMATIC picks the cheaper
+        # plan, with the session row limit as a memory guard — every device
+        # must HOLD a replicated build, so an unboundedly wide-but-cheap
+        # broadcast is still capped (join_max_broadcast_table_size analogue).
+        cheaper_to_broadcast = False
+        if mode == "AUTOMATIC" and est_right <= self._broadcast_limit():
+            r_bytes = est_right * _bytes_per_row(node.right.output_types)
+            l_bytes = self.est_rows(node.left) * _bytes_per_row(
+                node.left.output_types
+            )
+            cheaper_to_broadcast = r_bytes * self.num_devices <= l_bytes + r_bytes
         broadcast = (
             (mode == "BROADCAST")
-            or (mode == "AUTOMATIC" and est_right <= self._broadcast_limit())
+            or cheaper_to_broadcast
             or not node.left_keys
             or rpart.kind == "replicated"
             # null_anti needs a global view of the build side: a NULL build
@@ -395,6 +411,21 @@ class _Distributor:
             node.residual, "partitioned",
         )
         return out, _Part("hash", node.left_keys)
+
+
+def _bytes_per_row(types) -> float:
+    """Estimated bytes per row of a schema: fixed-width types by lane dtype,
+    varchar by a nominal dictionary-code + amortized-value estimate."""
+    total = 0.0
+    for t in types:
+        if getattr(t, "is_string", False):
+            total += 24.0  # int32 code lane + amortized dictionary bytes
+        else:
+            try:
+                total += float(t.np_dtype.itemsize)
+            except Exception:
+                total += 8.0
+    return max(total, 8.0)
 
 
 def _output_key_refs(node: Aggregate) -> tuple[IrExpr, ...]:

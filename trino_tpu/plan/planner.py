@@ -123,6 +123,12 @@ class Planner:
     def __init__(self, catalogs: CatalogManager, default_catalog: str = "tpch"):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
+        # (catalog, view name) -> parsed A.Query.  Views expand at analysis
+        # like the reference (StatementAnalyzer view expansion over
+        # tree/CreateView definitions); base-table access control runs on the
+        # expanded plan's scans.
+        self.views: dict[tuple[str, str], A.Query] = {}
+        self._view_stack: list[tuple[str, str]] = []  # cycle detection
 
     def plan(self, query) -> PlanNode:
         if isinstance(query, str):
@@ -584,6 +590,23 @@ class Planner:
                 # schema inside the default catalog, not a catalog name
                 catalog = self.default_catalog
                 connector = self.catalogs.get(catalog)
+            vkey = (catalog, r.name)
+            if vkey in self.views:
+                if vkey in self._view_stack:
+                    chain = " -> ".join(n for _, n in self._view_stack + [vkey])
+                    raise PlanningError(f"view cycle detected: {chain}")
+                self._view_stack.append(vkey)
+                try:
+                    # a view body sees no outer scope and no caller CTEs
+                    sub = self._plan_subquery_relation(
+                        self.views[vkey], None, {}
+                    )
+                finally:
+                    self._view_stack.pop()
+                alias = r.alias or r.name
+                return RelationPlan(
+                    sub.node, [Field(alias, f.name, f.type) for f in sub.fields]
+                )
             schema = connector.table_schema(r.name)
             names = tuple(schema.column_names())
             types = tuple(c.type for c in schema.columns)
@@ -1031,6 +1054,14 @@ class Planner:
                 ):
                     raise PlanningError(
                         f"offset frame not supported for window function {fn}"
+                    )
+                if frame.startswith("range:") and len(w_order_by) != 1:
+                    # Trino: "Window frame of type RANGE PRECEDING or
+                    # FOLLOWING requires single sort item in ORDER BY"
+                    # (PatternRecognitionAnalyzer-adjacent frame validation in
+                    # StatementAnalyzer); bounds resolve against ONE key.
+                    raise PlanningError(
+                        "RANGE offset frame requires exactly one ORDER BY key"
                     )
                 if fn == "ntile" and not (args and isinstance(args[0], Const)):
                     raise PlanningError("ntile() requires a literal bucket count")

@@ -383,6 +383,7 @@ class Coordinator:
                 out_parts = ntasks[consumer_of[f.id]]
                 sources = self._sources_payload(f, frag_by_id, task_urls)
                 payload_base = {
+                    "query_id": sm.query_id,
                     "fragment": plan_to_json(f.root),
                     "output_kind": f.output_kind,
                     "output_keys": [_encode(k) for k in f.output_keys],

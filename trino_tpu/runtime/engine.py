@@ -83,6 +83,8 @@ class Engine:
         self.events = EventListenerManager()
         self._query_seq = 0
         self._prepared: dict[str, str] = {}
+        self._view_sql: dict[tuple[str, str], str] = {}  # SHOW CREATE VIEW
+        self._tx_views = None  # (views, view_sql) snapshot inside a tx
         self._tx_snapshots = None  # name -> connector snapshot, inside a tx
         from .security import AllowAllAccessControl
 
@@ -257,6 +259,10 @@ class Engine:
             self._check_write(stmt.table, "update")
         elif isinstance(stmt, S.Merge):
             self._check_write(stmt.target, "merge")
+        elif isinstance(stmt, S.CreateView):
+            self._check_write(stmt.name, "create_view")
+        elif isinstance(stmt, S.DropView):
+            self._check_write(stmt.name, "drop_view")
         elif isinstance(stmt, S.SetSession):
             self.access_control.check_can_set_session(self.user, stmt.name)
 
@@ -350,11 +356,63 @@ class Engine:
             conn.drop_table(name)
             return [(0,)]
 
+        if isinstance(stmt, S.CreateView):
+            conn, catalog, name = self._target_ref(stmt.name)
+            name = name.split(".")[-1]  # match the planner's (catalog, table)
+            key = (catalog, name)
+            if name in conn.list_tables():
+                # Trino: TABLE_ALREADY_EXISTS — a view must not shadow a table
+                raise ValueError(f"table already exists: {stmt.name}")
+            if key in self.planner.views and not stmt.or_replace:
+                raise ValueError(f"view already exists: {stmt.name}")
+            prev = self.planner.views.get(key)
+            self.planner.views[key] = stmt.query
+            try:
+                self.plan(stmt.query)  # validate now: names, types, cycles
+            except Exception:
+                if prev is None:
+                    del self.planner.views[key]
+                else:
+                    self.planner.views[key] = prev
+                raise
+            self._view_sql[key] = stmt.sql
+            return [(0,)]
+
+        if isinstance(stmt, S.DropView):
+            _, catalog, name = self._target_ref(stmt.name)
+            key = (catalog, name.split(".")[-1])
+            if key not in self.planner.views:
+                if stmt.if_exists:
+                    return [(0,)]
+                raise KeyError(f"view not found: {stmt.name}")
+            del self.planner.views[key]
+            self._view_sql.pop(key, None)
+            return [(0,)]
+
+        if isinstance(stmt, S.ShowCreateView):
+            _, catalog, name = self._target_ref(stmt.name)
+            name = name.split(".")[-1]
+            sql_text = self._view_sql.get((catalog, name))
+            if sql_text is None:
+                raise KeyError(f"view not found: {stmt.name}")
+            return [(f"CREATE VIEW {name} AS {sql_text}",)]
+
         if isinstance(stmt, S.ShowTables):
             conn = self.catalogs.get(self.default_catalog)
-            return [(t,) for t in conn.list_tables()]
+            views = sorted(
+                n for (c, n) in self.planner.views if c == self.default_catalog
+            )
+            return [(t,) for t in conn.list_tables()] + [(v,) for v in views]
 
         if isinstance(stmt, S.DescribeTable):
+            _, catalog, name = self._target_ref(stmt.name)
+            vq = self.planner.views.get((catalog, name))
+            if vq is not None:
+                plan = self.plan(vq)
+                return [
+                    (n, t.name)
+                    for n, t in zip(plan.output_names, plan.output_types)
+                ]
             conn, name = self._target_conn(stmt.name)
             schema = conn.table_schema(name)
             return [(c.name, c.type.name) for c in schema.columns]
@@ -406,12 +464,15 @@ class Engine:
                 for name in self.catalogs.names()
                 if hasattr(self.catalogs.get(name), "snapshot")
             }
+            # view DDL participates: restore the registry on ROLLBACK too
+            self._tx_views = (dict(self.planner.views), dict(self._view_sql))
             return [(1,)]
 
         if isinstance(stmt, S.Commit):
             if self._tx_snapshots is None:
                 raise RuntimeError("no transaction in progress")
             self._tx_snapshots = None
+            self._tx_views = None
             return [(1,)]
 
         if isinstance(stmt, S.Rollback):
@@ -420,6 +481,9 @@ class Engine:
             for name, snap in self._tx_snapshots.items():
                 self.catalogs.get(name).restore(snap)
             self._tx_snapshots = None
+            if self._tx_views is not None:
+                self.planner.views, self._view_sql = self._tx_views
+                self._tx_views = None
             return [(1,)]
 
         raise NotImplementedError(f"statement {type(stmt).__name__}")

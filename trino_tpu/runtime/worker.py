@@ -60,8 +60,12 @@ class _Task:
     worker's buffered_bytes — bounding worker memory is the point of the
     file form (reference: OutputBufferMemoryManager)."""
 
-    def __init__(self, task_id: str):
+    def __init__(self, task_id: str, query_id: Optional[str] = None):
         self.task_id = task_id
+        # explicit query id from the task payload (ADVICE r3: deriving it by
+        # slicing the task id silently breaks per-query memory accounting if
+        # the id format ever changes)
+        self.query_id = query_id
         self.state = "RUNNING"
         self.error: Optional[str] = None
         # buffer_id -> list of entries (bytes | path str | None)
@@ -136,8 +140,9 @@ class Worker:
             tasks = list(self.tasks.values())
         out: dict[str, int] = {}
         for t in tasks:
-            # "q_<12 hex>..." -> the query id; anything else groups whole
-            qid = t.task_id[:14] if t.task_id.startswith("q_") else t.task_id
+            # explicit payload query id; tasks posted without one (tests,
+            # raw wire use) group under their own task id
+            qid = t.query_id or t.task_id
             with t.cond:
                 for chunks in t.buffers.values():
                     out[qid] = out.get(qid, 0) + sum(
@@ -189,7 +194,7 @@ class Worker:
     # ------------------------------------------------------- task execution
     def submit_task(self, req: dict) -> _Task:
         task_id = req["task_id"]
-        task = _Task(task_id)
+        task = _Task(task_id, query_id=req.get("query_id"))
         with self._lock:
             self.tasks[task_id] = task
         self._pool.submit(self._run_task, task, req)

@@ -19,7 +19,8 @@ from .parser import _Parser
 
 __all__ = [
     "Statement", "QueryStmt", "CreateTable", "CreateTableAs", "Insert",
-    "DropTable", "Explain", "ShowTables", "DescribeTable", "SetSession",
+    "DropTable", "CreateView", "DropView", "ShowCreateView", "Explain",
+    "ShowTables", "DescribeTable", "SetSession",
     "InsertValues", "Delete", "Update", "Merge", "MergeClause",
     "Prepare", "ExecuteStmt", "Deallocate",
     "StartTransaction", "Commit", "Rollback", "parse_statement",
@@ -67,6 +68,30 @@ class InsertValues(Statement):
 class DropTable(Statement):
     name: str
     if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class CreateView(Statement):
+    """CREATE [OR REPLACE] VIEW name AS query (reference:
+    core/trino-parser/.../tree/CreateView.java; expansion at analysis in
+    StatementAnalyzer).  The original SQL text is kept for SHOW CREATE VIEW
+    and re-validation."""
+
+    name: str
+    query: Query
+    sql: str
+    or_replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class ShowCreateView(Statement):
+    name: str
 
 
 @dataclass(frozen=True)
@@ -200,7 +225,18 @@ def _parse_statement(p: "_Parser", sql: str = "") -> Statement:
         return Explain(p.parse_query(), analyze, distributed)
 
     if p.accept_kw("CREATE"):
+        or_replace = False
+        if p.accept_kw("OR"):
+            p.expect_kw("REPLACE")
+            or_replace = True
+        if p.accept_kw("VIEW"):
+            name = _table_name(p)
+            p.expect_kw("AS")
+            body = sql[p.cur.pos :].rstrip().rstrip(";") if sql else ""
+            return CreateView(name, p.parse_query(), body, or_replace)
         p.expect_kw("TABLE")
+        if or_replace:
+            raise SqlSyntaxError("CREATE OR REPLACE TABLE is not supported")
         if_not_exists = False
         if p.accept_kw("IF"):
             p.expect_kw("NOT")
@@ -253,14 +289,20 @@ def _parse_statement(p: "_Parser", sql: str = "") -> Statement:
         return Insert(name, columns, p.parse_query())
 
     if p.accept_kw("DROP"):
-        p.expect_kw("TABLE")
+        is_view = bool(p.accept_kw("VIEW"))
+        if not is_view:
+            p.expect_kw("TABLE")
         if_exists = False
         if p.accept_kw("IF"):
             p.expect_kw("EXISTS")
             if_exists = True
-        return DropTable(_table_name(p), if_exists)
+        name = _table_name(p)
+        return DropView(name, if_exists) if is_view else DropTable(name, if_exists)
 
     if p.accept_kw("SHOW"):
+        if p.accept_kw("CREATE"):
+            p.expect_kw("VIEW")
+            return ShowCreateView(_table_name(p))
         p.expect_kw("TABLES")
         return ShowTables()
 
