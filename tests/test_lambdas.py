@@ -1,0 +1,193 @@
+"""Lambda expressions + higher-order functions and the new aggregate set
+(reference: sql/gen/LambdaBytecodeGenerator, operator/scalar/
+ArrayTransformFunction family, aggregation/CorrelationAggregation,
+ArrayAggregationFunction, MapAggAggregationFunction; VERDICT r3 missing #2/#4).
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import ColumnSchema
+from trino_tpu.data.types import BIGINT, DOUBLE, VARCHAR
+from trino_tpu.runtime.engine import Engine
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def engine():
+    conn = MemoryConnector()
+    conn.create_table(
+        "t",
+        [ColumnSchema("k", BIGINT), ColumnSchema("g", VARCHAR),
+         ColumnSchema("x", DOUBLE), ColumnSchema("y", DOUBLE),
+         ColumnSchema("s", VARCHAR)],
+    )
+    rng = np.random.default_rng(5)
+    n = 300
+    x = rng.normal(size=n)
+    conn.insert("t", {
+        "k": np.arange(n, dtype=np.int64),
+        "g": np.asarray([f"g{i % 3}" for i in range(n)], dtype=object),
+        "x": x,
+        "y": 3.0 * x + rng.normal(size=n) * 0.01,
+        "s": np.asarray([f"s{i % 4}" for i in range(n)], dtype=object),
+    })
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", conn)
+    return eng
+
+
+# ----------------------------------------------------------------- lambdas
+
+
+def test_transform_filter_literal_arrays(engine):
+    assert engine.execute("select transform(array[1,2,3], x -> x * 2)") == [([2, 4, 6],)]
+    assert engine.execute("select filter(array[1,2,3,4], x -> x > 2)") == [([3, 4],)]
+    assert engine.execute(
+        "select transform(array['a','bb'], x -> length(x))"
+    ) == [([1, 2],)]
+
+
+def test_reduce_and_matches(engine):
+    assert engine.execute(
+        "select reduce(array[1,2,3,4], 0, (s, x) -> s + x, s -> s)"
+    ) == [(10,)]
+    assert engine.execute(
+        "select reduce(array[2,3], 1, (s, x) -> s * x, s -> s * 10)"
+    ) == [(60,)]
+    rows = engine.execute(
+        "select any_match(array[1,2], x -> x > 1), all_match(array[1,2], x -> x > 1),"
+        " none_match(array[1,2], x -> x > 5)"
+    )
+    assert rows == [(True, False, True)]
+
+
+def test_zip_with_and_nested(engine):
+    assert engine.execute(
+        "select zip_with(array[1,2,3], array[10,20,30], (x, y) -> x + y)"
+    ) == [([11, 22, 33],)]
+    # nested HOF: lambda inside lambda-produced array
+    assert engine.execute(
+        "select transform(filter(array[1,2,3,4], x -> x % 2 = 0), x -> x + 1)"
+    ) == [([3, 5],)]
+
+
+def test_hof_over_column_arrays(engine):
+    rows = engine.execute(
+        "select g, cardinality(filter(split(s, 's'), x -> length(x) > 0)) as c"
+        " from t where k < 4 order by k"
+    )
+    assert [r[1] for r in rows] == [1, 1, 1, 1]
+
+
+def test_map_hofs(engine):
+    assert engine.execute(
+        "select transform_values(map(array['a','b'], array[1,2]), (k, v) -> v * 10)"
+    ) == [({"a": 10, "b": 20},)]
+    assert engine.execute(
+        "select map_filter(map(array['a','b'], array[1,2]), (k, v) -> v > 1)"
+    ) == [({"b": 2},)]
+
+
+def test_lambda_capture_rejected(engine):
+    with pytest.raises(Exception, match="capture"):
+        engine.execute("select transform(array[1,2], x -> x + k) from t")
+
+
+# -------------------------------------------------------- new aggregates
+
+
+def _np_corr(y, x):
+    return float(np.corrcoef(y, x)[0, 1])
+
+
+def test_corr_covar_regr(engine):
+    import numpy as np  # noqa: F811
+
+    conn = engine.catalogs.get("mem")
+    x = conn.read_split(conn.get_splits("t", 1)[0], ["x"])["x"]
+    y = conn.read_split(conn.get_splits("t", 1)[0], ["y"])["y"]
+    rows = engine.execute(
+        "select corr(y, x) as c, covar_pop(y, x) as cp, covar_samp(y, x) as cs,"
+        " regr_slope(y, x) as sl, regr_intercept(y, x) as ic from t"
+    )
+    c, cp, cs, sl, ic = rows[0]
+    assert abs(c - _np_corr(y, x)) < 1e-6
+    assert abs(cp - float(np.cov(y, x, bias=True)[0, 1])) < 1e-6
+    assert abs(cs - float(np.cov(y, x)[0, 1])) < 1e-6
+    slope, intercept = np.polyfit(x, y, 1)
+    assert abs(sl - slope) < 1e-6
+    assert abs(ic - intercept) < 1e-6
+
+
+def test_corr_grouped(engine):
+    rows = engine.execute("select g, corr(y, x) as c from t group by g order by g")
+    assert len(rows) == 3
+    for _, c in rows:
+        assert c > 0.99
+
+
+def test_array_agg(engine):
+    rows = engine.execute(
+        "select g, array_agg(k) as a from t where k < 6 group by g order by g"
+    )
+    assert rows == [("g0", [0, 3]), ("g1", [1, 4]), ("g2", [2, 5])]
+    # global + empty-ish group
+    rows = engine.execute("select array_agg(k) from t where k < 3")
+    assert sorted(rows[0][0]) == [0, 1, 2]
+
+
+def test_map_agg_and_listagg(engine):
+    rows = engine.execute(
+        "select g, map_agg(s, k) as m from t where k < 6 group by g order by g"
+    )
+    assert rows[0][1] == {"s0": 0, "s3": 3}
+    rows = engine.execute(
+        "select g, listagg(s, '|') as l from t where k < 6 group by g order by g"
+    )
+    assert rows == [("g0", "s0|s3"), ("g1", "s1|s0"), ("g2", "s2|s1")]
+
+
+def test_array_agg_distributed_gather():
+    """Host-collected aggregates run single-node semantics in the
+    distributed engine via raw-row repartition/gather (distribute.py
+    _raw_only)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    conn = MemoryConnector()
+    conn.create_table("d", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)])
+    conn.insert("d", {
+        "k": np.arange(16, dtype=np.int64) % 4,
+        "v": np.arange(16, dtype=np.int64),
+    })
+    eng = Engine(default_catalog="mem", distributed=True)
+    eng.register_catalog("mem", conn)
+    rows = eng.execute("select k, array_agg(v) as a from d group by k order by k")
+    assert [r[0] for r in rows] == [0, 1, 2, 3]
+    assert sorted(rows[0][1]) == [0, 4, 8, 12]
+
+
+def test_review_fixes(engine):
+    """Round-4 review regressions: DISTINCT in array_agg/listagg, exact
+    bigint division in lambda bodies, HOF arity errors, qualified DESCRIBE."""
+    rows = engine.execute(
+        "select array_agg(distinct g) as a, listagg(distinct g, ',') as l"
+        " from t where k < 9"
+    )
+    assert sorted(rows[0][0]) == ["g0", "g1", "g2"]
+    assert rows[0][1].count("g0") == 1
+    assert engine.execute(
+        "select transform(array[9007199254740993], v -> v / 1)"
+    ) == [([9007199254740993],)]
+    assert engine.execute(
+        "select transform(array[-7, 7], v -> v % 3)"
+    ) == [([-1, 1],)]
+    with pytest.raises(Exception, match="argument"):
+        engine.execute("select reduce(array[1,2], 0)")
+    engine.execute("create view sch.lv as select k from t where k < 2")
+    assert engine.execute("describe sch.lv") == [("k", "bigint")]
+    engine.execute("drop view sch.lv")

@@ -271,8 +271,16 @@ class LocalExecutor:
                         break
                     for nid, req in overflow.items():
                         caps[nid] = _pow2(max(req, caps[nid] * 2))
+        # plans with host-collected aggregates (array_agg/map_agg/listagg)
+        # cannot trace: their outputs intern structured values on the host.
+        # Run them eagerly — op-by-op dispatch with concrete arrays.
+        eager_only = _has_host_aggs(plan)
         for _ in range(12):  # capacity-retry loop (jitted path)
-            out_page, required = self._run(plan, inputs, caps)
+            if eager_only:
+                out_page, required = _trace_plan(plan, inputs, caps)
+                required = {k: int(v) for k, v in required.items()}
+            else:
+                out_page, required = self._run(plan, inputs, caps)
             for key, val in required.items():
                 if isinstance(key, int) and key < 0 and int(val) > 1:
                     raise RuntimeError(
@@ -456,6 +464,16 @@ class LocalExecutor:
         return out_page, required
 
 
+def _has_host_aggs(plan: PlanNode) -> bool:
+    from ..ops.relops import HOST_AGGS
+    from ..plan.nodes import walk
+
+    return any(
+        isinstance(n, Aggregate) and any(a.fn in HOST_AGGS for a in n.aggs)
+        for n in walk(plan)
+    )
+
+
 def _child_ids(nodes: dict[int, PlanNode], nid: int) -> list[int]:
     n = nodes[nid]
     ids = []
@@ -581,16 +599,24 @@ def _trace_plan(
                 None if a.arg is None else eval_expr(a.arg, s.cols, s.capacity)
                 for a in node.aggs
             ]
-            specs = [AggSpec(a.fn, a.distinct, a.param) for a in node.aggs]
+            args2 = [
+                None if a.arg2 is None else eval_expr(a.arg2, s.cols, s.capacity)
+                for a in node.aggs
+            ]
+            specs = [AggSpec(a.fn, a.distinct, a.param, a.sep) for a in node.aggs]
             out_keys, out_aggs, out_live, n_groups = group_aggregate(
-                keys, args, specs, s.live, G
+                keys, args, specs, s.live, G, agg_args2=args2
             )
             report(nid, n_groups)
             cols: list[ColumnVal] = []
             for (data, valid), kv in zip(out_keys, keys):
                 cols.append(ColumnVal(data, _none_if_all(valid), kv.dict, kv.type))
-            for (data, valid), a, arg in zip(out_aggs, node.aggs, args):
-                d = arg.dict if (arg is not None and a.fn in ("min", "max")) else None
+            for out, a, arg in zip(out_aggs, node.aggs, args):
+                if len(out) == 3:  # host-collected: carries its own dictionary
+                    data, valid, d = out
+                else:
+                    data, valid = out
+                    d = arg.dict if (arg is not None and a.fn in ("min", "max")) else None
                 cols.append(ColumnVal(data, valid, d, a.type))
             return _Stage(cols, out_live)
 

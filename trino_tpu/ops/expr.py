@@ -136,8 +136,19 @@ def _np_to_jnp(t: Type):
 # -------------------------------------------------------------------- calls
 
 
+_HOF_OPS = {
+    "transform", "filter_arr", "reduce", "any_match", "all_match",
+    "none_match", "zip_with", "transform_keys", "transform_values",
+    "map_filter",
+}
+
+
 def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
     op = e.op
+    if op in _HOF_OPS:
+        return _hof_fn(op, e, cols, n)
+    if op == "map_construct":
+        return _map_construct(e, cols, n)
     if op in ("and", "or"):
         return _kleene(op, e, cols, n)
     if op == "not":
@@ -617,6 +628,349 @@ def _dict_object_str(picked, base: ColumnVal, ft, ok) -> ColumnVal:
     codes = jnp.take(jnp.asarray(remap.astype(np.int32)), base.data)
     okl = jnp.take(jnp.asarray(ok), base.data)
     return ColumnVal(codes, _and_valid(base.valid, okl), Dictionary(uniq), ft)
+
+
+# ---------------------------------------------------------------- lambdas
+
+
+def _py_eval(ir, env: dict):
+    """Host interpreter for lambda bodies over python scalars (reference:
+    LambdaBytecodeGenerator compiles these to JVM bytecode; here dictionary
+    interning means each body runs once per DISTINCT value, so an
+    interpreter is cheap).  Returns a python value; None == SQL NULL."""
+    from ..plan.ir import (
+        CaseWhen as _CW, Call as _Call, Const as _Const, InListIr as _InL,
+        LambdaVarIr as _LV, LikeIr as _Like,
+    )
+
+    if isinstance(ir, _Const):
+        v = ir.value
+        if v is not None and ir.type.is_decimal:
+            return v / (10.0 ** ir.type.scale)
+        return v
+    if isinstance(ir, _LV):
+        return env[ir.name]
+    if isinstance(ir, _CW):
+        for cond, res in ir.whens:
+            if _py_eval(cond, env) is True:
+                return _py_eval(res, env)
+        return None if ir.default is None else _py_eval(ir.default, env)
+    if isinstance(ir, _InL):
+        v = _py_eval(ir.operand, env)
+        if v is None:
+            return None
+        hit = v in ir.values
+        return (not hit) if ir.negated else hit
+    if isinstance(ir, _Like):
+        v = _py_eval(ir.operand, env)
+        if v is None:
+            return None
+        hit = bool(_like_regex(ir.pattern).match(str(v)))
+        return (not hit) if ir.negated else hit
+    if not isinstance(ir, _Call):
+        raise NotImplementedError(f"lambda body node {type(ir).__name__}")
+
+    op = ir.op
+    if op == "and":
+        vals = [_py_eval(a, env) for a in ir.args]
+        if any(v is False for v in vals):
+            return False
+        return None if any(v is None for v in vals) else True
+    if op == "or":
+        vals = [_py_eval(a, env) for a in ir.args]
+        if any(v is True for v in vals):
+            return True
+        return None if any(v is None for v in vals) else False
+    if op == "not":
+        v = _py_eval(ir.args[0], env)
+        return None if v is None else (not v)
+    if op == "is_null":
+        return _py_eval(ir.args[0], env) is None
+    if op == "coalesce":
+        for a in ir.args:
+            v = _py_eval(a, env)
+            if v is not None:
+                return v
+        return None
+    if op == "cast":
+        v = _py_eval(ir.args[0], env)
+        if v is None:
+            return None
+        t = ir.type
+        if t.is_string:
+            return str(v)
+        if t.is_floating or t.is_decimal:
+            return float(v)
+        if getattr(t, "is_integer", False):
+            return int(v)
+        return v
+
+    vals = [_py_eval(a, env) for a in ir.args]
+    if any(v is None for v in vals):  # strict NULL propagation
+        return None
+    if op == "add":
+        return vals[0] + vals[1]
+    if op == "sub":
+        return vals[0] - vals[1]
+    if op == "mul":
+        return vals[0] * vals[1]
+    if op == "div":
+        if vals[1] == 0:
+            return None
+        if isinstance(vals[0], int) and isinstance(vals[1], int):
+            # SQL integer division truncates toward zero; stay exact in int
+            q = abs(vals[0]) // abs(vals[1])
+            return -q if (vals[0] < 0) != (vals[1] < 0) else q
+        return vals[0] / vals[1]
+    if op == "mod":
+        if vals[1] == 0:
+            return None
+        if isinstance(vals[0], int) and isinstance(vals[1], int):
+            # sign follows the dividend (SQL), exact in int
+            r = abs(vals[0]) % abs(vals[1])
+            return -r if vals[0] < 0 else r
+        import math as _math
+
+        return vals[0] - vals[1] * float(_math.trunc(vals[0] / vals[1]))
+    if op == "neg":
+        return -vals[0]
+    if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+        import operator as _op
+
+        f = {"eq": _op.eq, "ne": _op.ne, "lt": _op.lt,
+             "le": _op.le, "gt": _op.gt, "ge": _op.ge}[op]
+        return f(vals[0], vals[1])
+    if op == "abs":
+        return abs(vals[0])
+    if op in ("upper", "lower", "trim", "ltrim", "rtrim"):
+        return {
+            "upper": str.upper, "lower": str.lower, "trim": str.strip,
+            "ltrim": str.lstrip, "rtrim": str.rstrip,
+        }[op](str(vals[0]))
+    if op == "length":
+        return len(str(vals[0]))
+    if op == "concat_str":
+        return "".join(str(v) for v in vals)
+    if op == "nullif":
+        return None if vals[0] == vals[1] else vals[0]
+    if op in ("sqrt", "ln", "exp", "floor", "ceil", "round", "power"):
+        import math as _math
+
+        if op == "sqrt":
+            return _math.sqrt(vals[0]) if vals[0] >= 0 else None
+        if op == "ln":
+            return _math.log(vals[0]) if vals[0] > 0 else None
+        if op == "exp":
+            return _math.exp(vals[0])
+        if op == "floor":
+            return float(_math.floor(vals[0]))
+        if op == "ceil":
+            return float(_math.ceil(vals[0]))
+        if op == "round":
+            return round(vals[0], int(vals[1]) if len(vals) > 1 else 0)
+        return float(vals[0]) ** float(vals[1])
+    raise NotImplementedError(f"lambda body op {op}")
+
+
+def _coerce_elem(v, t):
+    """Canonicalize an interpreter result for interning (numpy scalars ->
+    python; decimal results stay float — _lambda outputs are cast f64)."""
+    if v is None:
+        return None
+    if isinstance(v, np.generic):
+        v = v.item()
+    if getattr(t, "is_integer", False):
+        return int(v)
+    if t.is_floating:
+        return float(v)
+    if t.is_string:
+        return str(v)
+    return v
+
+
+def _map_construct(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
+    """map(keys_array, values_array) — 2-D pair table over (key-code,
+    value-code), canonical sorted-pair interning (data/types.py MapType)."""
+    a = eval_expr(e.args[0], cols, n)
+    b = eval_expr(e.args[1], cols, n)
+    avals, bvals = a.dict.values, b.dict.values
+    mat = np.zeros((len(avals), len(bvals)), dtype=np.int32)
+    okm = np.zeros((len(avals), len(bvals)), dtype=bool)
+    table: dict = {}
+    for i, ks in enumerate(avals):
+        for j, vs in enumerate(bvals):
+            if len(ks) != len(vs):
+                mat[i, j] = 0  # length mismatch -> NULL (Trino: error)
+                continue
+            d = dict(zip(ks, vs))
+            try:
+                items = sorted(d.items())
+            except TypeError:
+                items = sorted(d.items(), key=lambda it: repr(it[0]))
+            mat[i, j] = table.setdefault(tuple(items), len(table))
+            okm[i, j] = True
+    uniq = np.empty(max(len(table), 1), dtype=object)
+    uniq[0] = ()
+    for v, c in table.items():
+        uniq[c] = v
+    codes = jnp.asarray(mat)[a.data, b.data]
+    ok = jnp.asarray(okm)[a.data, b.data]
+    return ColumnVal(
+        codes, _and_valid(_and_valid(a.valid, b.valid), ok), Dictionary(uniq), e.type
+    )
+
+
+def _hof_fn(op: str, e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
+    """Higher-order functions over dict-coded arrays/maps: the lambda body is
+    interpreted once per DISTINCT container value on the host; device lanes
+    just re-gather codes (reference: ArrayTransformFunction et al., compiled
+    per row by LambdaBytecodeGenerator — interning beats codegen here)."""
+    from ..plan.ir import LambdaIr
+
+    a = eval_expr(e.args[0], cols, n)
+    vals = a.dict.values  # object array of tuples (arrays) or pair-tuples (maps)
+
+    def intern_out(new_vals, out_type) -> ColumnVal:
+        # dict-based interning, no sort: results may mix None with values
+        # inside tuples, which np.unique's comparison sort would reject
+        table: dict = {}
+        remap_ = np.empty(len(new_vals), dtype=np.int32)
+        for i, v in enumerate(new_vals):
+            remap_[i] = table.setdefault(v, len(table))
+        uniq = np.empty(max(len(table), 1), dtype=object)
+        uniq[0] = ()
+        for v, c in table.items():
+            uniq[c] = v
+        codes = jnp.take(jnp.asarray(remap_), a.data)
+        return ColumnVal(codes, a.valid, Dictionary(uniq), out_type)
+
+    def bool_out(table, ok) -> ColumnVal:
+        t = jnp.take(jnp.asarray(np.asarray(table, dtype=np.bool_)), a.data)
+        okl = jnp.take(jnp.asarray(np.asarray(ok, dtype=np.bool_)), a.data)
+        return ColumnVal(t, _and_valid(a.valid, okl), None, BOOLEAN)
+
+    if op == "transform":
+        lam: LambdaIr = e.args[1]
+        p = lam.params[0]
+        et = e.type.element
+        out = [
+            tuple(_coerce_elem(_py_eval(lam.body, {p: x}), et) for x in v)
+            for v in vals
+        ]
+        return intern_out(out, e.type)
+    if op == "filter_arr":
+        lam = e.args[1]
+        p = lam.params[0]
+        out = [
+            tuple(x for x in v if _py_eval(lam.body, {p: x}) is True)
+            for v in vals
+        ]
+        return intern_out(out, e.type)
+    if op in ("any_match", "all_match", "none_match"):
+        lam = e.args[1]
+        p = lam.params[0]
+        table, ok = [], []
+        for v in vals:
+            results = [_py_eval(lam.body, {p: x}) for x in v]
+            if op == "any_match":
+                val = (
+                    True if any(r is True for r in results)
+                    else (None if any(r is None for r in results) else False)
+                )
+            elif op == "all_match":
+                val = (
+                    False if any(r is False for r in results)
+                    else (None if any(r is None for r in results) else True)
+                )
+            else:
+                val = (
+                    False if any(r is True for r in results)
+                    else (None if any(r is None for r in results) else True)
+                )
+            table.append(bool(val) if val is not None else False)
+            ok.append(val is not None)
+        return bool_out(table, ok)
+    if op == "reduce":
+        init_ir, comb, fin = e.args[1], e.args[2], e.args[3]
+        init = _py_eval(init_ir, {})
+        sp, xp = comb.params
+        table, ok = [], []
+        for v in vals:
+            state = init
+            for x in v:
+                state = _py_eval(comb.body, {sp: state, xp: x})
+            r = _py_eval(fin.body, {fin.params[0]: state})
+            table.append(_coerce_elem(r, e.type))
+            ok.append(r is not None)
+        if e.type.is_string:
+            return _dict_object_str(
+                [t if t is not None else "" for t in table], a, e.type,
+                np.asarray(ok, dtype=bool),
+            )
+        arr = np.asarray(
+            [t if t is not None else 0 for t in table], dtype=e.type.np_dtype
+        )
+        out = jnp.take(jnp.asarray(arr), a.data)
+        okl = jnp.take(jnp.asarray(np.asarray(ok, dtype=bool)), a.data)
+        return ColumnVal(out, _and_valid(a.valid, okl), None, e.type)
+    if op == "zip_with":
+        b = eval_expr(e.args[1], cols, n)
+        lam = e.args[2]
+        xp, yp = lam.params
+        et = e.type.element
+        bvals = b.dict.values
+        # 2-D result-code table over (a-code, b-code); device gathers by pair
+        mat = np.zeros((len(vals), len(bvals)), dtype=np.int32)
+        table: dict = {}
+        for i, va in enumerate(vals):
+            for j, vb in enumerate(bvals):
+                ln = max(len(va), len(vb))
+                pa = tuple(va) + (None,) * (ln - len(va))
+                pb = tuple(vb) + (None,) * (ln - len(vb))
+                res = tuple(
+                    _coerce_elem(_py_eval(lam.body, {xp: x, yp: y}), et)
+                    for x, y in zip(pa, pb)
+                )
+                mat[i, j] = table.setdefault(res, len(table))
+        uniq = np.empty(max(len(table), 1), dtype=object)
+        uniq[0] = ()
+        for val, code in table.items():
+            uniq[code] = val
+        codes = jnp.asarray(mat)[a.data, b.data]
+        return ColumnVal(codes, _and_valid(a.valid, b.valid), Dictionary(uniq), e.type)
+    # map HOFs: values are canonical tuples of (k, v) pairs
+    lam = e.args[1]
+    kp, vp = lam.params
+    if op == "transform_keys":
+        kt = e.type.key
+        out = []
+        for m in vals:
+            d = {
+                _coerce_elem(_py_eval(lam.body, {kp: k, vp: v}), kt): v
+                for k, v in m
+            }
+            try:  # canonical map form: pairs sorted by key (data/types.py)
+                items = sorted(d.items())
+            except TypeError:
+                items = sorted(d.items(), key=lambda it: repr(it[0]))
+            out.append(tuple(items))
+        return intern_out(out, e.type)
+    if op == "transform_values":
+        vt = e.type.value
+        out = [
+            tuple(
+                (k, _coerce_elem(_py_eval(lam.body, {kp: k, vp: v}), vt))
+                for k, v in m
+            )
+            for m in vals
+        ]
+        return intern_out(out, e.type)
+    # map_filter
+    out = [
+        tuple((k, v) for k, v in m if _py_eval(lam.body, {kp: k, vp: v}) is True)
+        for m in vals
+    ]
+    return intern_out(out, e.type)
 
 
 def _array_fn(op: str, e: Call, args: list[ColumnVal], n: int) -> ColumnVal:

@@ -42,9 +42,22 @@ __all__ = [
 class AggSpec:
     fn: str  # sum | count | count_star | min | max | avg | bool_and |
     #          bool_or | stddev_samp | stddev_pop | var_samp | var_pop |
-    #          percentile
+    #          percentile | corr | covar_samp | covar_pop | regr_slope |
+    #          regr_intercept | array_agg | map_agg | listagg
     distinct: bool = False
     param: Optional[float] = None  # percentile's p
+    sep: Optional[str] = None  # listagg separator
+
+
+# aggregates computed on the HOST over the sorted grouping (their outputs
+# are dict-coded structured values a traced kernel cannot intern); the
+# executor routes plans containing them through eager execution
+HOST_AGGS = frozenset({"array_agg", "map_agg", "listagg"})
+
+# two-argument moment aggregates (pairwise sums on the device)
+MOMENT_AGGS = frozenset(
+    {"corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept"}
+)
 
 
 @dataclass(frozen=True)
@@ -153,20 +166,24 @@ def group_aggregate(
     specs: Sequence[AggSpec],
     live: jnp.ndarray,
     num_groups_cap: int,
+    agg_args2: Optional[Sequence[Optional[ColumnVal]]] = None,
 ):
     """Sort-based grouped aggregation.
 
-    Returns (out_keys: list[(data, valid)], out_aggs: list[(data, valid)],
-    out_live, n_groups) where outputs have capacity `num_groups_cap` and
-    n_groups is the true group count (> cap == overflow, host retries).
+    Returns (out_keys: list[(data, valid)], out_aggs: list[(data, valid)
+    or (data, valid, Dictionary) for host-collected aggregates], out_live,
+    n_groups) where outputs have capacity `num_groups_cap` and n_groups is
+    the true group count (> cap == overflow, host retries).
     """
     n = live.shape[0]
     G = num_groups_cap
+    if agg_args2 is None:
+        agg_args2 = [None] * len(specs)
 
     if not key_vals:
-        return _global_aggregate(agg_args, specs, live)
+        return _global_aggregate(agg_args, specs, live, agg_args2)
 
-    fast = _direct_code_aggregate(key_vals, agg_args, specs, live)
+    fast = _direct_code_aggregate(key_vals, agg_args, specs, live, agg_args2)
     if fast is not None:
         return fast
 
@@ -235,11 +252,16 @@ def group_aggregate(
     # ---- aggregates -------------------------------------------------------
     out_aggs = _fused_aggs(
         agg_args, specs, perm, seg, live_s, G, n,
-        sorted_segments=True, boundaries=(starts, ends),
+        sorted_segments=True, boundaries=(starts, ends), agg_args2=agg_args2,
     )
     for i, (arg, spec) in enumerate(zip(agg_args, specs)):
         if out_aggs[i] is None and spec.fn == "approx_distinct":
             out_aggs[i] = _segment_hll(arg, perm, seg, live_s, G, n)
+            continue
+        if out_aggs[i] is None and spec.fn in HOST_AGGS:
+            out_aggs[i] = _host_collect_agg(
+                spec, arg, agg_args2[i], perm, seg, live_s, G, n
+            )
             continue
         if out_aggs[i] is None:  # DISTINCT/percentile: need sorted adjacency
             if i == vs_ix[0]:
@@ -258,15 +280,18 @@ def group_aggregate(
 _DIRECT_DOMAIN_LIMIT = 4096
 
 
-def _direct_code_aggregate(key_vals, agg_args, specs, live):
+def _direct_code_aggregate(key_vals, agg_args, specs, live, agg_args2=None):
     """Fast path: every group key is a dictionary-coded column with no nulls
     and the key-domain product is small -> segment id IS the fused code; no
     sort, no scatter, just segment reductions.  This is the case the
     reference's DictionaryAwarePageProjection + BigintGroupByHash fast paths
     chase (TPC-H Q1: returnflag x linestatus = 6 groups over 6B rows at
     SF1000); on TPU it turns group-by into a bandwidth-bound reduction."""
+    if agg_args2 is None:
+        agg_args2 = [None] * len(specs)
     if any(
-        s.distinct or s.fn in ("percentile", "approx_distinct") for s in specs
+        s.distinct or s.fn in ("percentile", "approx_distinct") or s.fn in HOST_AGGS
+        for s in specs
     ):
         return None
     domains = []
@@ -303,13 +328,13 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live):
     for kv, codes in zip(key_vals, codes_per_key):
         out_keys.append((jnp.asarray(codes.astype(np.int32)), None))
 
-    out_aggs = _fused_aggs(agg_args, specs, None, seg, live, G, n)
+    out_aggs = _fused_aggs(agg_args, specs, None, seg, live, G, n, agg_args2=agg_args2)
     return out_keys, out_aggs, out_live, n_groups
 
 
 def _fused_aggs(
     agg_args, specs, perm, seg, live_s, G, n,
-    sorted_segments=False, boundaries=None,
+    sorted_segments=False, boundaries=None, agg_args2=None,
 ):
     """All non-DISTINCT aggregates of a GROUP BY in one fused segmented
     reduction (ops/pallas/segreduce.py): on TPU a single Pallas pass over HBM
@@ -337,10 +362,41 @@ def _fused_aggs(
             count_memo[key] = add(SegRed("count", None, valid))
         return count_memo[key]
 
+    if agg_args2 is None:
+        agg_args2 = [None] * len(specs)
     recipe: list = []
-    for arg, spec in zip(agg_args, specs):
-        if spec.distinct or spec.fn in ("percentile", "approx_distinct"):
+    for arg, arg2, spec in zip(agg_args, agg_args2, specs):
+        if (
+            spec.distinct
+            or spec.fn in ("percentile", "approx_distinct")
+            or spec.fn in HOST_AGGS
+        ):
             recipe.append(None)
+            continue
+        if spec.fn in MOMENT_AGGS:
+            # pairwise moments (reference: CorrelationAggregation etc.):
+            # sums of y, x, xy, xx, yy over rows where BOTH args are non-NULL
+            y = arg.data if perm is None else jnp.take(arg.data, perm)
+            x = arg2.data if perm is None else jnp.take(arg2.data, perm)
+            yv = _valid_of(arg, n)
+            xv = _valid_of(arg2, n)
+            if perm is not None:
+                yv = jnp.take(yv, perm)
+                xv = jnp.take(xv, perm)
+            pv = yv & xv & live_s
+            y = y.astype(jnp.float64)
+            x = x.astype(jnp.float64)
+            recipe.append(
+                (
+                    "moment", spec.fn,
+                    add(SegRed("sum", y, pv)),
+                    add(SegRed("sum", x, pv)),
+                    add(SegRed("sum", x * y, pv)),
+                    add(SegRed("sum", x * x, pv)),
+                    add(SegRed("sum", y * y, pv)),
+                    add(SegRed("count", None, pv)),
+                )
+            )
             continue
         if spec.fn == "count_star":
             recipe.append(("count", add_count(live_s)))
@@ -431,6 +487,32 @@ def _fused_aggs(
             if fn.startswith("stddev"):
                 var = jnp.sqrt(var)
             out.append((var, ok))
+        elif kind == "moment":
+            _, fn, iy, ix, ixy, ixx, iyy, ic = r
+            sy, sx, sxy, sxx, syy, cnt = (
+                results[iy], results[ix], results[ixy],
+                results[ixx], results[iyy], results[ic],
+            )
+            nf = jnp.where(cnt > 0, cnt, 1).astype(jnp.float64)
+            cov_n = sxy - sx * sy / nf  # n * cov
+            varx_n = jnp.maximum(sxx - sx * sx / nf, 0.0)  # n * var(x)
+            vary_n = jnp.maximum(syy - sy * sy / nf, 0.0)
+            if fn == "covar_pop":
+                out.append((cov_n / nf, cnt > 0))
+            elif fn == "covar_samp":
+                denom = jnp.where(cnt > 1, nf - 1.0, 1.0)
+                out.append((cov_n / denom, cnt > 1))
+            elif fn == "corr":
+                denom = jnp.sqrt(varx_n * vary_n)
+                ok = (cnt > 1) & (denom > 0)
+                out.append((cov_n / jnp.where(ok, denom, 1.0), ok))
+            elif fn == "regr_slope":
+                ok = (cnt > 1) & (varx_n > 0)
+                out.append((cov_n / jnp.where(ok, varx_n, 1.0), ok))
+            else:  # regr_intercept = mean(y) - slope * mean(x)
+                ok = (cnt > 1) & (varx_n > 0)
+                slope = cov_n / jnp.where(ok, varx_n, 1.0)
+                out.append(((sy - slope * sx) / nf, ok))
         else:  # dictmm: map best rank back to a dictionary code
             _, fn, arg, si, ci = r
             best_rank, cnt = results[si], results[ci]
@@ -540,6 +622,115 @@ def _segment_hll(
     return counts, None
 
 
+def _host_collect_agg(
+    spec: AggSpec,
+    arg: ColumnVal,
+    arg2: Optional[ColumnVal],
+    perm: jnp.ndarray,
+    seg: jnp.ndarray,
+    live_s: jnp.ndarray,
+    G: int,
+    n: int,
+):
+    """array_agg / map_agg / listagg: per-group collection on the HOST over
+    the sorted grouping (reference: aggregation/ArrayAggregationFunction,
+    MapAggAggregationFunction, ListaggAggregationFunction).  Their outputs
+    are interned structured values (dict-coded tuples) that a traced kernel
+    cannot build, so the executor routes plans containing them through eager
+    execution; under jit this raises at trace time."""
+    import jax.core as _core
+
+    if isinstance(seg, _core.Tracer):
+        raise NotImplementedError(
+            f"{spec.fn} requires eager execution (host-collected aggregate)"
+        )
+    from ..data.page import Dictionary
+
+    perm_h = np.asarray(perm)
+    seg_h = np.asarray(seg)
+    live_h = np.asarray(live_s)
+
+    def decode(cv: ColumnVal):
+        d = np.asarray(cv.data)[perm_h]
+        ok = np.asarray(_valid_of(cv, n))[perm_h] & live_h
+        if cv.dict is not None:
+            table = np.asarray(cv.dict.values, dtype=object)
+            d = table[np.clip(d, 0, max(len(table) - 1, 0))]
+        return d, ok
+
+    vals, vok = decode(arg)
+    keep = live_h & (seg_h < G)
+    gs = seg_h[keep]
+    v_k, ok_k = vals[keep], vok[keep]
+    bounds = np.flatnonzero(np.diff(gs)) + 1
+    group_ids = gs[np.concatenate([[0], bounds])] if len(gs) else np.zeros(0, np.int64)
+    runs = np.split(np.arange(len(gs)), bounds)
+
+    def _dedup_first(seq):
+        seen: set = set()
+        out = []
+        for v in seq:
+            if v not in seen:
+                seen.add(v)
+                out.append(v)
+        return out
+
+    results: list = []
+    res_ok: list[bool] = []
+    if spec.fn == "listagg":
+        sep = spec.sep if spec.sep is not None else ","
+        for r in runs:
+            parts = [str(v_k[i]) for i in r if ok_k[i]]
+            if spec.distinct:
+                parts = _dedup_first(parts)
+            results.append(sep.join(parts))
+            res_ok.append(bool(parts))
+    elif spec.fn == "array_agg":
+        for r in runs:
+            vals_r = [
+                v_k[i].item() if isinstance(v_k[i], np.generic) else v_k[i]
+                for i in r if ok_k[i]
+            ]
+            if spec.distinct:
+                vals_r = _dedup_first(vals_r)
+            results.append(tuple(vals_r))
+            res_ok.append(True)
+    else:  # map_agg(key, value): NULL keys skipped, last value wins
+        kv, kok = decode(arg2)
+        kv_k, kok_k = kv[keep], kok[keep]
+        for r in runs:
+            m: dict = {}
+            for i in r:
+                if ok_k[i]:
+                    key = v_k[i].item() if isinstance(v_k[i], np.generic) else v_k[i]
+                    val = (
+                        (kv_k[i].item() if isinstance(kv_k[i], np.generic) else kv_k[i])
+                        if kok_k[i]
+                        else None
+                    )
+                    m[key] = val
+            try:  # canonical map form: pairs sorted by key (data/types.py)
+                items = sorted(m.items())
+            except TypeError:
+                items = sorted(m.items(), key=lambda it: repr(it[0]))
+            results.append(tuple(items))
+            res_ok.append(bool(m))
+
+    # intern without sorting (tuples may mix None with values; np.unique
+    # would compare them) and scatter into the [G] output frame
+    table: dict = {}
+    codes = np.zeros((G,), np.int32)
+    valid = np.zeros((G,), bool)
+    for gid, res, ok in zip(group_ids, results, res_ok):
+        codes[gid] = table.setdefault(res, len(table))
+        valid[gid] = ok
+    uniq = np.empty(max(len(table), 1), dtype=object)
+    uniq[0] = "" if spec.fn == "listagg" else ()
+    for val, code in table.items():
+        uniq[code] = val
+    return jnp.asarray(codes), jnp.asarray(valid), Dictionary(uniq)
+
+
 def _segment_agg(
     arg: Optional[ColumnVal],
     spec: AggSpec,
@@ -595,7 +786,7 @@ def _segment_percentile(
     return vals, vcnt > 0
 
 
-def _global_aggregate(agg_args, specs, live):
+def _global_aggregate(agg_args, specs, live, agg_args2=None):
     """No GROUP BY: one output row even over empty input (SQL semantics).
 
     Non-DISTINCT aggregates run through the fused segmented reduction with a
@@ -603,12 +794,22 @@ def _global_aggregate(agg_args, specs, live):
     Kahan-compensated float paths serve global sums too (a plain jnp.sum of
     "float64" on TPU silently accumulates in f32)."""
     n = live.shape[0]
+    if agg_args2 is None:
+        agg_args2 = [None] * len(specs)
     seg = jnp.zeros((n,), jnp.int32)
-    fused = _fused_aggs(agg_args, specs, None, seg, live, 1, n)
+    fused = _fused_aggs(agg_args, specs, None, seg, live, 1, n, agg_args2=agg_args2)
     out_aggs = []
-    for (arg, spec), pre in zip(zip(agg_args, specs), fused):
+    for i, ((arg, spec), pre) in enumerate(zip(zip(agg_args, specs), fused)):
         if pre is not None:
             out_aggs.append(pre)
+            continue
+        if spec.fn in HOST_AGGS:
+            perm1 = jnp.arange(n, dtype=jnp.int32)
+            out_aggs.append(
+                _host_collect_agg(
+                    spec, arg, agg_args2[i], perm1, seg, live, 1, n
+                )
+            )
             continue
         valid = _valid_of(arg, n) & live
         if spec.fn == "approx_distinct":

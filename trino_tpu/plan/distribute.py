@@ -246,7 +246,9 @@ class _Distributor:
         # approx_distinct: an HLL estimate of per-worker estimates is garbage
         # (merging would need the sketch registers, not the counts)
         _raw_only = {"percentile", "stddev_samp", "stddev_pop", "var_samp",
-                     "var_pop", "approx_distinct"}
+                     "var_pop", "approx_distinct",
+                     "corr", "covar_samp", "covar_pop", "regr_slope",
+                     "regr_intercept", "array_agg", "map_agg", "listagg"}
         has_distinct = any(a.distinct for a in node.aggs)
         if has_distinct or any(a.fn in _raw_only for a in node.aggs):
             if nk == 0:

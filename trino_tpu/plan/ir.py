@@ -17,7 +17,10 @@ from typing import Optional, Sequence
 
 from ..data.types import BOOLEAN, Type
 
-__all__ = ["IrExpr", "FieldRef", "Const", "Call", "CaseWhen", "InListIr", "LikeIr", "field_refs"]
+__all__ = [
+    "IrExpr", "FieldRef", "Const", "Call", "CaseWhen", "InListIr", "LikeIr",
+    "LambdaIr", "LambdaVarIr", "field_refs",
+]
 
 
 class IrExpr:
@@ -63,6 +66,26 @@ class Call(IrExpr):
 
     def __str__(self) -> str:
         return f"{self.op}({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class LambdaVarIr(IrExpr):
+    """A lambda parameter reference inside a LambdaIr body."""
+
+    name: str
+    type: Type
+
+
+@dataclass(frozen=True)
+class LambdaIr(IrExpr):
+    """A typed lambda (reference: sql/ir — LambdaExpression survives into the
+    IR and is bound by LambdaBytecodeGenerator; here the body is interpreted
+    per distinct dictionary value on the host, ops/expr.py _hof_fn).
+    `type` is the body's result type."""
+
+    params: tuple[str, ...]
+    body: IrExpr
+    type: Type
 
 
 @dataclass(frozen=True)
@@ -113,6 +136,8 @@ def _collect(e: IrExpr, out: set[int]) -> None:
             _collect(e.default, out)
     elif isinstance(e, (InListIr, LikeIr)):
         _collect(e.operand, out)
+    elif isinstance(e, LambdaIr):
+        _collect(e.body, out)
 
 
 def substitute(e: IrExpr, exprs: Sequence["IrExpr"]) -> IrExpr:
@@ -132,6 +157,8 @@ def substitute(e: IrExpr, exprs: Sequence["IrExpr"]) -> IrExpr:
         return InListIr(substitute(e.operand, exprs), e.values, e.negated, e.type)
     if isinstance(e, LikeIr):
         return LikeIr(substitute(e.operand, exprs), e.pattern, e.negated, e.type)
+    if isinstance(e, LambdaIr):
+        return LambdaIr(e.params, substitute(e.body, exprs), e.type)
     return e
 
 
@@ -151,4 +178,6 @@ def remap(e: IrExpr, mapping: dict[int, int]) -> IrExpr:
         return InListIr(remap(e.operand, mapping), e.values, e.negated, e.type)
     if isinstance(e, LikeIr):
         return LikeIr(remap(e.operand, mapping), e.pattern, e.negated, e.type)
+    if isinstance(e, LambdaIr):
+        return LambdaIr(e.params, remap(e.body, mapping), e.type)
     return e

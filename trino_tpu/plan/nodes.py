@@ -92,15 +92,21 @@ class Project(PlanNode):
 @dataclass(frozen=True)
 class AggCall:
     """One aggregate: fn in {sum, count, min, max, avg, count_star, bool_and,
-    bool_or, stddev_samp, stddev_pop, var_samp, var_pop, percentile};
+    bool_or, stddev_samp, stddev_pop, var_samp, var_pop, percentile,
+    corr, covar_samp, covar_pop, regr_slope, regr_intercept,
+    array_agg, map_agg, listagg};
     arg is None only for count_star. distinct per-agg (count(distinct x)).
-    param: extra literal parameter (approx_percentile's p)."""
+    param: extra literal parameter (approx_percentile's p).
+    arg2: second argument (corr(y, x)'s x, map_agg's value, listagg's
+    WITHIN GROUP order key).  sep: listagg separator literal."""
 
     fn: str
     arg: Optional[IrExpr]
     type: Type
     distinct: bool = False
     param: Optional[float] = None
+    arg2: Optional[IrExpr] = None
+    sep: Optional[str] = None
 
 
 @dataclass(frozen=True)

@@ -169,8 +169,9 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         for k in node.group_keys:
             child_needed |= field_refs(k)
         for i in keep_aggs:
-            if node.aggs[i].arg is not None:
-                child_needed |= field_refs(node.aggs[i].arg)
+            for a_arg in (node.aggs[i].arg, node.aggs[i].arg2):
+                if a_arg is not None:
+                    child_needed |= field_refs(a_arg)
         child, m = _prune(node.child, child_needed)
         new_keys = tuple(remap(k, m) for k in node.group_keys)
         new_aggs = tuple(
@@ -180,6 +181,8 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
                 node.aggs[i].type,
                 node.aggs[i].distinct,
                 node.aggs[i].param,
+                None if node.aggs[i].arg2 is None else remap(node.aggs[i].arg2, m),
+                node.aggs[i].sep,
             )
             for i in keep_aggs
         )

@@ -56,6 +56,8 @@ _AGG_FNS = {
     "approx_distinct", "approx_percentile", "count_if",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every", "arbitrary", "any_value",
+    "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+    "array_agg", "map_agg", "listagg", "string_agg",
 }
 
 _CMP_OPS = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
@@ -828,6 +830,43 @@ class Planner:
                 # deterministic choice (min) — any value qualifies
                 aggs.append(AggCall("min", arg, arg.type))
                 continue
+            if name in ("corr", "covar_samp", "covar_pop", "regr_slope",
+                        "regr_intercept"):
+                # two-argument moments (reference: aggregation/
+                # CorrelationAggregation, CovarianceAggregation,
+                # RegressionAggregation — pairwise sums of x, y, xx, yy, xy)
+                if len(fc.args) != 2:
+                    raise PlanningError(f"{name} takes exactly two arguments")
+                y = _cast_ir(arg, DOUBLE)
+                x = _cast_ir(t.translate(fc.args[1]), DOUBLE)
+                aggs.append(AggCall(name, y, DOUBLE, arg2=x))
+                continue
+            if name == "array_agg":
+                from ..data.types import ArrayType
+
+                aggs.append(
+                    AggCall("array_agg", arg, ArrayType(arg.type), fc.distinct)
+                )
+                continue
+            if name == "map_agg":
+                from ..data.types import MapType
+
+                if len(fc.args) != 2:
+                    raise PlanningError("map_agg takes exactly two arguments")
+                v = t.translate(fc.args[1])
+                aggs.append(
+                    AggCall("map_agg", arg, MapType(arg.type, v.type), arg2=v)
+                )
+                continue
+            if name in ("listagg", "string_agg"):
+                sep = ","
+                if len(fc.args) > 1:
+                    sep_ir = t.translate(fc.args[1])
+                    if not isinstance(sep_ir, Const):
+                        raise PlanningError("listagg separator must be a literal")
+                    sep = str(sep_ir.value)
+                aggs.append(AggCall("listagg", arg, VARCHAR, fc.distinct, sep=sep))
+                continue
             if name == "every":
                 name = "bool_and"
             if name == "stddev":
@@ -902,6 +941,8 @@ class Planner:
                 a.type,
                 a.distinct,
                 a.param,
+                None if a.arg2 is None else remap(a.arg2, shift),
+                a.sep,
             )
             for a in aggs
         ]
@@ -1562,10 +1603,105 @@ class _Translator:
             return Const(_fold_arith(op, a.value, b.value), out_t)
         return Call(op, (a, b), out_t)
 
+    _HOF_FNS = {
+        "transform", "filter", "reduce", "any_match", "all_match",
+        "none_match", "zip_with", "transform_keys", "transform_values",
+        "map_filter",
+    }
+
+    def _lambda_body(self, lam, param_types) -> IrExpr:
+        """Translate a lambda body with its parameters bound to LambdaVarIr
+        (reference: ExpressionAnalyzer lambda scopes).  Enclosing-row column
+        captures are rejected — HOFs evaluate per distinct dictionary value
+        on the host, where row context does not exist."""
+        from .ir import LambdaVarIr, field_refs
+
+        if not isinstance(lam, A.Lambda):
+            raise PlanningError("expected a lambda argument (x -> expression)")
+        if len(lam.params) != len(param_types):
+            raise PlanningError(
+                f"lambda takes {len(lam.params)} parameters, expected {len(param_types)}"
+            )
+        sub = _LambdaTranslator(self, dict(zip(lam.params, param_types)))
+        body = sub.translate(lam.body)
+        if field_refs(body):
+            raise PlanningError(
+                "lambda capture of enclosing columns is not supported"
+            )
+        if body.type.is_decimal:
+            # the host interpreter evaluates decimals as plain floats
+            body = _cast_ir(body, DOUBLE)
+        return body
+
+    def _hof(self, e: A.FuncCall) -> IrExpr:
+        """Higher-order array/map functions (reference: sql/gen/
+        LambdaBytecodeGenerator + operator/scalar/ArrayTransformFunction,
+        ArrayFilterFunction, ArrayReduceFunction, ZipWithFunction,
+        MapTransformValuesFunction...)."""
+        from ..data.types import ArrayType, MapType
+        from .ir import LambdaIr
+
+        name = e.name
+        _arity = {
+            "transform": 2, "filter": 2, "any_match": 2, "all_match": 2,
+            "none_match": 2, "reduce": 4, "zip_with": 3, "transform_keys": 2,
+            "transform_values": 2, "map_filter": 2,
+        }
+        if len(e.args) != _arity[name]:
+            raise PlanningError(
+                f"{name} takes {_arity[name]} arguments, got {len(e.args)}"
+            )
+        if name in ("transform", "filter", "any_match", "all_match", "none_match"):
+            arr = self.translate(e.args[0])
+            if not arr.type.is_array:
+                raise PlanningError(f"{name} requires an array argument")
+            body = self._lambda_body(e.args[1], [arr.type.element])
+            lam = LambdaIr(e.args[1].params, body, body.type)
+            if name == "transform":
+                return Call("transform", (arr, lam), ArrayType(body.type))
+            if name == "filter":
+                return Call("filter_arr", (arr, lam), arr.type)
+            return Call(name, (arr, lam), BOOLEAN)
+        if name == "reduce":
+            arr = self.translate(e.args[0])
+            if not arr.type.is_array:
+                raise PlanningError("reduce requires an array argument")
+            init = self.translate(e.args[1])
+            comb_body = self._lambda_body(
+                e.args[2], [init.type, arr.type.element]
+            )
+            finish_body = self._lambda_body(e.args[3], [init.type])
+            comb = LambdaIr(e.args[2].params, comb_body, comb_body.type)
+            fin = LambdaIr(e.args[3].params, finish_body, finish_body.type)
+            return Call("reduce", (arr, init, comb, fin), finish_body.type)
+        if name == "zip_with":
+            a = self.translate(e.args[0])
+            b = self.translate(e.args[1])
+            if not (a.type.is_array and b.type.is_array):
+                raise PlanningError("zip_with requires two array arguments")
+            body = self._lambda_body(
+                e.args[2], [a.type.element, b.type.element]
+            )
+            lam = LambdaIr(e.args[2].params, body, body.type)
+            return Call("zip_with", (a, b, lam), ArrayType(body.type))
+        # map HOFs
+        m = self.translate(e.args[0])
+        if not m.type.is_map:
+            raise PlanningError(f"{name} requires a map argument")
+        body = self._lambda_body(e.args[1], [m.type.key, m.type.value])
+        lam = LambdaIr(e.args[1].params, body, body.type)
+        if name == "transform_keys":
+            return Call("transform_keys", (m, lam), MapType(body.type, m.type.value))
+        if name == "transform_values":
+            return Call("transform_values", (m, lam), MapType(m.type.key, body.type))
+        return Call("map_filter", (m, lam), m.type)
+
     def _func(self, e: A.FuncCall) -> IrExpr:
         name = e.name
         if name in _AGG_FNS:
             raise PlanningError(f"aggregate {name} in non-aggregate context")
+        if name in self._HOF_FNS:
+            return self._hof(e)
         args = tuple(self.translate(a) for a in e.args)
         if name == "date_add":
             base, n, unit = args
@@ -1770,6 +1906,15 @@ class _Translator:
             if not args[0].type.is_array:
                 raise PlanningError("element_at requires an array or map")
             return Call("element_at", args, args[0].type.element)
+        if name == "map":
+            from ..data.types import MapType
+
+            if len(args) != 2 or not (args[0].type.is_array and args[1].type.is_array):
+                raise PlanningError("map() takes two array arguments")
+            return Call(
+                "map_construct", args,
+                MapType(args[0].type.element, args[1].type.element),
+            )
         if name == "map_keys":
             if not args[0].type.is_map:
                 raise PlanningError("map_keys requires a map")
@@ -1930,6 +2075,26 @@ def _cast_relation(rel: RelationPlan, types: list[Type]) -> RelationPlan:
     names = tuple(f.name or f"_c{i}" for i, f in enumerate(rel.fields))
     node = Project(rel.node, exprs, names)
     return RelationPlan(node, [Field(f.qualifier, f.name, t) for f, t in zip(rel.fields, types)])
+
+
+class _LambdaTranslator(_Translator):
+    """Translator with lambda parameters in scope (innermost wins); chains
+    through nested lambdas by merging the parent's parameter map."""
+
+    def __init__(self, parent: _Translator, params: dict):
+        super().__init__(parent.scope, parent.outer, parent.agg_map, parent.grouped)
+        merged = dict(getattr(parent, "_lambda_params", {}))
+        merged.update(params)
+        self._lambda_params = merged
+
+    def translate(self, e: A.Expr) -> IrExpr:
+        if isinstance(e, A.Ident) and len(e.parts) == 1:
+            t = self._lambda_params.get(e.parts[0])
+            if t is not None:
+                from .ir import LambdaVarIr
+
+                return LambdaVarIr(e.parts[0], t)
+        return super().translate(e)
 
 
 def _as_bool(e: IrExpr) -> IrExpr:

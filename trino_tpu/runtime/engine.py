@@ -74,8 +74,12 @@ class Engine:
             from ..exec.spmd import SpmdExecutor
 
             self.executor = SpmdExecutor(self.catalogs, default_catalog, devices)
+            # coordinator-local fallback for plans that cannot shard_map
+            # (host-collected aggregates)
+            self._local_fallback = LocalExecutor(self.catalogs, default_catalog)
         else:
             self.executor = LocalExecutor(self.catalogs, default_catalog)
+            self._local_fallback = self.executor
         self.distributed = distributed
         self.session = SessionProperties()
         from .events import EventListenerManager
@@ -119,8 +123,16 @@ class Engine:
                     self.user, n.catalog, n.table, n.column_names
                 )
         if self.distributed:
+            from ..exec.compiler import _has_host_aggs
             from ..plan.distribute import distribute
 
+            if _has_host_aggs(plan):
+                # host-collected aggregates (array_agg/map_agg/listagg)
+                # intern structured values on the host and cannot trace
+                # under shard_map; their input is gathered anyway, so run
+                # the whole plan coordinator-local (reference:
+                # COORDINATOR_DISTRIBUTION stages)
+                return plan
             plan = distribute(
                 plan, self.catalogs, self.executor.num_devices, self.session
             )
@@ -175,6 +187,11 @@ class Engine:
         return ooc.execute(plan)
 
     def _execute_planned(self, plan) -> Page:
+        if self.distributed:
+            from ..exec.compiler import _has_host_aggs
+
+            if _has_host_aggs(plan):
+                return self._local_fallback.execute(plan)
         budget = self._device_memory_budget()
         if budget and not self.distributed:
             from ..exec.spill import estimate_plan_bytes
@@ -406,7 +423,7 @@ class Engine:
 
         if isinstance(stmt, S.DescribeTable):
             _, catalog, name = self._target_ref(stmt.name)
-            vq = self.planner.views.get((catalog, name))
+            vq = self.planner.views.get((catalog, name.split(".")[-1]))
             if vq is not None:
                 plan = self.plan(vq)
                 return [

@@ -119,6 +119,15 @@ class WindowFunc(Expr):
 
 
 @dataclass(frozen=True)
+class Lambda(Expr):
+    """x -> expr / (x, y) -> expr — argument to a higher-order function
+    (reference: sql/tree/LambdaExpression)."""
+
+    params: tuple[str, ...]
+    body: Expr
+
+
+@dataclass(frozen=True)
 class CaseExpr(Expr):
     whens: tuple[tuple[Expr, Expr], ...]  # (condition, result)
     default: Optional[Expr]  # ELSE

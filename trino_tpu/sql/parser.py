@@ -432,7 +432,48 @@ class _Parser:
         return None
 
     # ----------------------------------------------------------- expressions
+    def _try_lambda(self) -> Optional[Expr]:
+        """Lambda lookahead: IDENT '->' | '(' IDENT (',' IDENT)* ')' '->'.
+        Consumes nothing unless a lambda head is certain (reference grammar:
+        SqlBase.g4 lambda rule)."""
+        toks = self.tokens
+        i = self.i
+        if (
+            toks[i].kind == "IDENT"
+            and toks[i + 1].kind == "OP"
+            and toks[i + 1].value == "->"
+        ):
+            self.i = i + 2
+            from .ast import Lambda
+
+            return Lambda((toks[i].value.lower(),), self.parse_or())
+        if toks[i].kind == "OP" and toks[i].value == "(":
+            j = i + 1
+            params: list[str] = []
+            while toks[j].kind == "IDENT":
+                params.append(toks[j].value.lower())
+                j += 1
+                if toks[j].kind == "OP" and toks[j].value == ",":
+                    j += 1
+                    continue
+                break
+            if (
+                params
+                and toks[j].kind == "OP"
+                and toks[j].value == ")"
+                and toks[j + 1].kind == "OP"
+                and toks[j + 1].value == "->"
+            ):
+                self.i = j + 2
+                from .ast import Lambda
+
+                return Lambda(tuple(params), self.parse_or())
+        return None
+
     def parse_expr(self) -> Expr:
+        lam = self._try_lambda()
+        if lam is not None:
+            return lam
         return self.parse_or()
 
     def parse_or(self) -> Expr:
