@@ -48,11 +48,9 @@ import jax  # noqa: E402
 # Persistent compilation cache: XLA/Mosaic compiles over the TPU tunnel take
 # tens of seconds and dominate time-to-first-number; cached compiles bring
 # repeat bench runs (each driver round) down to seconds of warmup.
-try:
-    jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+from trino_tpu.utils.compilecache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(_REPO)
 
 from tests.tpch_queries import QUERIES  # noqa: E402
 

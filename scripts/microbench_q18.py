@@ -12,8 +12,8 @@ sys.path.insert(0, _REPO)
 
 import jax
 
-jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from trino_tpu.utils.compilecache import enable_persistent_cache
+enable_persistent_cache(_REPO)
 
 import jax.numpy as jnp
 import numpy as np

@@ -34,12 +34,15 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache: the suite's dominant cost is compiling
 # the same fragment programs run after run (8-device shard_map plans take
 # minutes); the on-disk cache makes re-runs hit warm compiles.
-_cache_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass  # older jax without the knobs
+_repo_root = os.path.dirname(os.path.dirname(__file__))
+import sys  # noqa: E402
+
+sys.path.insert(0, _repo_root)
+from trino_tpu.utils.compilecache import enable_persistent_cache  # noqa: E402
+
+# host-fingerprinted dir: XLA:CPU AOT entries from another machine fail to
+# load (and recompile) on hosts with different CPU features
+enable_persistent_cache(_repo_root)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

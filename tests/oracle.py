@@ -48,8 +48,224 @@ def _fold_decimal_literals(sql: str) -> str:
     return "".join(parts)
 
 
+def _split_top_commas(s: str) -> list[str]:
+    parts: list[str] = []
+    depth = 0
+    cur: list[str] = []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        parts.append(tail)
+    return parts
+
+
+def _norm_expr(s: str) -> str:
+    return re.sub(r"\s+", " ", s.strip()).lower()
+
+
+def _expand_rollup(sql: str) -> str:
+    """sqlite has no ROLLUP/grouping(): expand `group by rollup (k1..kn)`
+    into a UNION ALL of the n+1 grouping levels, NULL-ing rolled-away keys
+    in the select list and folding grouping(k) to 0/1 literals."""
+    m = re.search(r"group\s+by\s+rollup\s*\(", sql, flags=re.IGNORECASE)
+    if not m:
+        return sql
+    # balanced-paren extent of the rollup key list
+    i = m.end()
+    depth = 1
+    while depth:
+        if sql[i] == "(":
+            depth += 1
+        elif sql[i] == ")":
+            depth -= 1
+        i += 1
+    keys = _split_top_commas(sql[m.end(): i - 1])
+    gb_start, gb_end = m.start(), i
+
+    def depth_at(pos: int) -> int:
+        d = 0
+        for ch in sql[:pos]:
+            if ch == "(":
+                d += 1
+            elif ch == ")":
+                d -= 1
+        return d
+    d0 = depth_at(gb_start)
+    # owning SELECT: nearest preceding `select` at the same paren depth
+    sel_start = None
+    for sm in re.finditer(r"\bselect\b", sql[:gb_start], flags=re.IGNORECASE):
+        if depth_at(sm.start()) == d0:
+            sel_start = sm.start()
+    assert sel_start is not None, "rollup: owning select not found"
+    # end of the select block: first order by / limit / closing paren at d0
+    block_end = len(sql)
+    d = d0
+    j = gb_end
+    while j < len(sql):
+        ch = sql[j]
+        if ch == "(":
+            d += 1
+        elif ch == ")":
+            d -= 1
+            if d < d0:
+                block_end = j
+                break
+        if d == d0:
+            tail = sql[j:]
+            if re.match(r"order\s+by\b", tail, flags=re.IGNORECASE) or re.match(
+                r"limit\b", tail, flags=re.IGNORECASE
+            ):
+                block_end = j
+                break
+        j += 1
+    block = sql[sel_start:block_end]
+    head = block[: gb_start - sel_start]  # select ... from ... where ...
+    after_gb = block[gb_end - sel_start:]  # having ... (if any)
+
+    # select-items segment: between `select` and the top-level ` from `
+    hm = re.match(r"select\s+", head, flags=re.IGNORECASE)
+    items_from = hm.end()
+    d = 0
+    items_to = None
+    for k in range(items_from, len(head)):
+        ch = head[k]
+        if ch == "(":
+            d += 1
+        elif ch == ")":
+            d -= 1
+        elif d == 0 and re.match(r"\bfrom\b", head[k:], flags=re.IGNORECASE):
+            items_to = k
+            break
+    assert items_to is not None, "rollup: FROM not found"
+    items = _split_top_commas(head[items_from:items_to])
+    norm_keys = [_norm_expr(k) for k in keys]
+
+    def item_variant(item: str, level: int) -> str:
+        # fold grouping(k) -> 0/1 for this level
+        def fold_grouping(mm: re.Match) -> str:
+            arg = _norm_expr(mm.group(1))
+            ki = norm_keys.index(arg) if arg in norm_keys else -1
+            return "1" if (ki >= level or ki < 0) else "0"
+
+        item = re.sub(
+            r"grouping\s*\(([^()]*)\)", fold_grouping, item, flags=re.IGNORECASE
+        )
+        ni = _norm_expr(item)
+        for ki, nk in enumerate(norm_keys):
+            if ki < level:
+                continue  # key survives at this level
+            if ni == nk:
+                name = re.split(r"[.\s]", item.strip())[-1]
+                return f"null as {name}"
+            am = re.match(
+                r"(.*?)\s+as\s+(\w+)\s*$", item.strip(),
+                flags=re.IGNORECASE | re.DOTALL,
+            )
+            if am and _norm_expr(am.group(1)) == nk:
+                return f"null as {am.group(2)}"
+        return item
+
+    variants = []
+    for level in range(len(keys), -1, -1):
+        sel_items = ", ".join(item_variant(it, level) for it in items)
+        gb = (
+            " group by " + ", ".join(keys[:level]) if level else " "
+        )
+        variants.append(
+            "select " + sel_items + " " + head[items_to:] + gb + after_gb
+        )
+    wrapped = "select * from (" + " union all ".join(variants) + ") _rollup_x "
+    return sql[:sel_start] + wrapped + sql[block_end:]
+
+
+_ORDER_STOP = re.compile(r"(limit|rows|range|groups)\b", re.IGNORECASE)
+
+
+def _null_item(item: str) -> str:
+    """Append the Trino default null ordering (NULLS LAST for ASC, FIRST
+    for DESC) to one ORDER BY item; sqlite's default is the opposite."""
+    if re.search(r"\bnulls\s+(first|last)\b", item, flags=re.IGNORECASE):
+        return item
+    s = item.rstrip()
+    if not s:
+        return item
+    ws = item[len(s):]
+    desc = re.search(r"\bdesc\s*$", s, flags=re.IGNORECASE)
+    return s + (" nulls first" if desc else " nulls last") + ws
+
+
+def _fix_null_order(sql: str) -> str:
+    """Rewrite every ORDER BY item (top level and windows) to spell out the
+    engine's null ordering, since sqlite's default differs."""
+    out: list[str] = []
+    i = 0
+    while True:
+        m = re.search(r"\border\s+by\b", sql[i:], flags=re.IGNORECASE)
+        if not m:
+            out.append(sql[i:])
+            break
+        start = i + m.end()
+        out.append(sql[i:start])
+        j = start
+        depth = 0
+        item_start = start
+        pieces: list[str] = []
+        while j < len(sql):
+            ch = sql[j]
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                if depth == 0:
+                    break
+                depth -= 1
+            elif ch == "," and depth == 0:
+                pieces.append(_null_item(sql[item_start:j]))
+                pieces.append(",")
+                item_start = j + 1
+            elif depth == 0 and not sql[j - 1].isalnum() and sql[j - 1] != "_":
+                if _ORDER_STOP.match(sql, j):
+                    break
+            j += 1
+        pieces.append(_null_item(sql[item_start:j]))
+        out.append("".join(pieces))
+        i = j
+    return "".join(out)
+
+
+class _StdAgg:
+    """Welford-free simple two-pass stddev/variance aggregate for sqlite."""
+
+    def __init__(self, samp: bool, sqrt: bool):
+        self.samp, self.sqrt = samp, sqrt
+        self.vals: list[float] = []
+
+    def step(self, v):
+        if v is not None:
+            self.vals.append(float(v))
+
+    def finalize(self):
+        n = len(self.vals)
+        if n < (2 if self.samp else 1):
+            return None
+        mean = sum(self.vals) / n
+        ss = sum((x - mean) ** 2 for x in self.vals)
+        var = ss / (n - 1) if self.samp else ss / n
+        return math.sqrt(var) if self.sqrt else var
+
+
 def to_sqlite(sql: str) -> str:
     sql = _fold_decimal_literals(sql)
+    sql = _expand_rollup(sql)
+    sql = _fix_null_order(sql)
     # date '1994-01-01' [+-] interval 'n' unit  ->  date('1994-01-01', '+n units')
     def _interval(m: re.Match) -> str:
         base, sign, n, unit = m.group(1), m.group(2), m.group(3), m.group(4)
@@ -98,6 +314,14 @@ class SqliteOracle:
             all_schemas.update(schemas)
         self.conn = sqlite3.connect(":memory:")
         self.conn.create_function("power", 2, lambda a, b: float(a) ** float(b))
+        for name_, samp, sqrt_ in (
+            ("stddev_samp", True, True), ("stddev_pop", False, True),
+            ("var_samp", True, False), ("var_pop", False, False),
+        ):
+            self.conn.create_aggregate(
+                name_, 1,
+                (lambda s=samp, q=sqrt_: _StdAgg(s, q)),  # type: ignore[arg-type]
+            )
         for name, cols in tables.items():
             schema = dict(all_schemas[name])
             col_defs = ", ".join(f"{c} {_sqlite_type(schema[c])}" for c in cols)

@@ -705,3 +705,1310 @@ order by wname, sm_type, cc_name
 limit 100
 """
 ORDERED["q99"] = True
+
+# ---- round 4 batch 1: reporting/rollup/exists/inventory shapes -----------
+
+QUERIES["q02"] = """
+with wscs as
+ (select sold_date_sk, sales_price
+  from (select ws_sold_date_sk sold_date_sk, ws_ext_sales_price sales_price
+        from web_sales
+        union all
+        select cs_sold_date_sk sold_date_sk, cs_ext_sales_price sales_price
+        from catalog_sales) x),
+ wswscs as
+ (select d_week_seq,
+        sum(case when (d_day_name = 'Sunday') then sales_price else null end) sun_sales,
+        sum(case when (d_day_name = 'Monday') then sales_price else null end) mon_sales,
+        sum(case when (d_day_name = 'Tuesday') then sales_price else null end) tue_sales,
+        sum(case when (d_day_name = 'Wednesday') then sales_price else null end) wed_sales,
+        sum(case when (d_day_name = 'Thursday') then sales_price else null end) thu_sales,
+        sum(case when (d_day_name = 'Friday') then sales_price else null end) fri_sales,
+        sum(case when (d_day_name = 'Saturday') then sales_price else null end) sat_sales
+ from wscs, date_dim
+ where d_date_sk = sold_date_sk
+ group by d_week_seq)
+select d_week_seq1,
+       round(sun_sales1 / sun_sales2, 2), round(mon_sales1 / mon_sales2, 2),
+       round(tue_sales1 / tue_sales2, 2), round(wed_sales1 / wed_sales2, 2),
+       round(thu_sales1 / thu_sales2, 2), round(fri_sales1 / fri_sales2, 2),
+       round(sat_sales1 / sat_sales2, 2)
+from (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1, wed_sales wed_sales1,
+             thu_sales thu_sales1, fri_sales fri_sales1, sat_sales sat_sales1
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2000) y,
+     (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2, wed_sales wed_sales2,
+             thu_sales thu_sales2, fri_sales fri_sales2, sat_sales sat_sales2
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2000 + 1) z
+where d_week_seq1 = d_week_seq2 - 53
+order by d_week_seq1
+"""
+ORDERED["q02"] = True
+
+QUERIES["q05"] = """
+with ssr as
+ (select s_store_id, sum(sales_price) as sales, sum(profit) as profit,
+         sum(return_amt) as returns_amt, sum(net_loss) as profit_loss
+  from (select ss_store_sk as store_sk, ss_sold_date_sk as date_sk,
+               ss_ext_sales_price as sales_price, ss_net_profit as profit,
+               cast(0 as double) as return_amt, cast(0 as double) as net_loss
+        from store_sales
+        union all
+        select sr_store_sk as store_sk, sr_returned_date_sk as date_sk,
+               cast(0 as double) as sales_price, cast(0 as double) as profit,
+               sr_return_amt as return_amt, sr_net_loss as net_loss
+        from store_returns) salesreturns, date_dim, store
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-23' and date '2000-08-23' + interval '14' day
+    and store_sk = s_store_sk
+  group by s_store_id),
+ csr as
+ (select cp_catalog_page_id, sum(sales_price) as sales, sum(profit) as profit,
+         sum(return_amt) as returns_amt, sum(net_loss) as profit_loss
+  from (select cs_catalog_page_sk as page_sk, cs_sold_date_sk as date_sk,
+               cs_ext_sales_price as sales_price, cs_net_profit as profit,
+               cast(0 as double) as return_amt, cast(0 as double) as net_loss
+        from catalog_sales
+        union all
+        select cr_catalog_page_sk as page_sk, cr_returned_date_sk as date_sk,
+               cast(0 as double) as sales_price, cast(0 as double) as profit,
+               cr_return_amount as return_amt, cr_net_loss as net_loss
+        from catalog_returns) salesreturns, date_dim, catalog_page
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-23' and date '2000-08-23' + interval '14' day
+    and page_sk = cp_catalog_page_sk
+  group by cp_catalog_page_id),
+ wsr as
+ (select web_site_id, sum(sales_price) as sales, sum(profit) as profit,
+         sum(return_amt) as returns_amt, sum(net_loss) as profit_loss
+  from (select ws_web_site_sk as wsr_web_site_sk, ws_sold_date_sk as date_sk,
+               ws_ext_sales_price as sales_price, ws_net_profit as profit,
+               cast(0 as double) as return_amt, cast(0 as double) as net_loss
+        from web_sales
+        union all
+        select ws_web_site_sk as wsr_web_site_sk, wr_returned_date_sk as date_sk,
+               cast(0 as double) as sales_price, cast(0 as double) as profit,
+               wr_return_amt as return_amt, wr_net_loss as net_loss
+        from web_returns
+             left outer join web_sales
+               on (wr_item_sk = ws_item_sk and wr_order_number = ws_order_number)
+        ) salesreturns, date_dim, web_site
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-23' and date '2000-08-23' + interval '14' day
+    and wsr_web_site_sk = web_site_sk
+  group by web_site_id)
+select channel, id, sum(sales) as sales, sum(returns_amt) as returns_amt,
+       sum(profit - profit_loss) as profit
+from (select 'store channel' as channel, 'store' || s_store_id as id,
+             sales, returns_amt, profit, profit_loss
+      from ssr
+      union all
+      select 'catalog channel' as channel,
+             'catalog_page' || cp_catalog_page_id as id,
+             sales, returns_amt, profit, profit_loss
+      from csr
+      union all
+      select 'web channel' as channel, 'web_site' || web_site_id as id,
+             sales, returns_amt, profit, profit_loss
+      from wsr) x
+group by rollup (channel, id)
+order by channel, id, sales
+limit 100
+"""
+ORDERED["q05"] = True
+
+QUERIES["q09"] = """
+select case when (select count(*) from store_sales
+                  where ss_quantity between 1 and 20) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 1 and 20)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 1 and 20) end bucket1,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 21 and 40) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 21 and 40)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 21 and 40) end bucket2,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 41 and 60) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 41 and 60)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 41 and 60) end bucket3,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 61 and 80) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 61 and 80)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 61 and 80) end bucket4,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 81 and 100) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 81 and 100)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 81 and 100) end bucket5
+from reason
+where r_reason_sk = 1
+"""
+ORDERED["q09"] = True
+
+QUERIES["q10"] = """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3,
+       cd_dep_count, count(*) cnt4, cd_dep_employed_count, count(*) cnt5,
+       cd_dep_college_count, count(*) cnt6
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county in ('Rush County', 'Toole County', 'Jefferson County',
+                    'Dona Ana County', 'La Porte County')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 2000
+                and d_moy between 1 and 1 + 3)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk and d_year = 2000
+                 and d_moy between 1 and 1 + 3)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk and d_year = 2000
+                    and d_moy between 1 and 1 + 3))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+"""
+ORDERED["q10"] = True
+
+QUERIES["q16"] = """
+select count(distinct cs_order_number) as order_count,
+       sum(cs_ext_ship_cost) as total_shipping_cost,
+       sum(cs_net_profit) as total_net_profit
+from catalog_sales cs1, date_dim, customer_address, call_center
+where d_date between date '2002-02-01' and date '2002-02-01' + interval '60' day
+  and cs1.cs_ship_date_sk = d_date_sk
+  and cs1.cs_ship_addr_sk = ca_address_sk and ca_state = 'GA'
+  and cs1.cs_call_center_sk = cc_call_center_sk
+  and exists (select * from catalog_sales cs2
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  and not exists (select * from catalog_returns cr1
+                  where cs1.cs_order_number = cr1.cr_order_number)
+order by count(distinct cs_order_number)
+limit 100
+"""
+ORDERED["q16"] = True
+
+QUERIES["q17"] = """
+select i_item_id, i_item_desc, s_state, count(ss_quantity) as store_sales_quantitycount,
+       avg(ss_quantity) as store_sales_quantityave,
+       stddev_samp(ss_quantity) as store_sales_quantitystdev,
+       stddev_samp(ss_quantity) / avg(ss_quantity) as store_sales_quantitycov,
+       count(sr_return_quantity) as store_returns_quantitycount,
+       avg(sr_return_quantity) as store_returns_quantityave,
+       stddev_samp(sr_return_quantity) as store_returns_quantitystdev,
+       stddev_samp(sr_return_quantity) / avg(sr_return_quantity) as store_returns_quantitycov,
+       count(cs_quantity) as catalog_sales_quantitycount,
+       avg(cs_quantity) as catalog_sales_quantityave,
+       stddev_samp(cs_quantity) as catalog_sales_quantitystdev,
+       stddev_samp(cs_quantity) / avg(cs_quantity) as catalog_sales_quantitycov
+from store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+where d1.d_quarter_name = '2000Q1' and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_quarter_name in ('2000Q1', '2000Q2', '2000Q3')
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_quarter_name in ('2000Q1', '2000Q2', '2000Q3')
+group by i_item_id, i_item_desc, s_state
+order by i_item_id, i_item_desc, s_state
+limit 100
+"""
+ORDERED["q17"] = True
+
+QUERIES["q18"] = """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cast(cs_quantity as double)) agg1,
+       avg(cast(cs_list_price as double)) agg2,
+       avg(cast(cs_coupon_amt as double)) agg3,
+       avg(cast(cs_sales_price as double)) agg4,
+       avg(cast(cs_net_profit as double)) agg5,
+       avg(cast(c_birth_year as double)) agg6,
+       avg(cast(cd1.cd_dep_count as double)) agg7
+from catalog_sales, customer_demographics cd1, customer_demographics cd2,
+     customer, customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd1.cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd1.cd_gender = 'F' and cd1.cd_education_status = 'Unknown'
+  and c_current_cdemo_sk = cd2.cd_demo_sk
+  and c_current_addr_sk = ca_address_sk
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+group by rollup (i_item_id, ca_country, ca_state, ca_county)
+order by ca_country, ca_state, ca_county, i_item_id
+limit 100
+"""
+ORDERED["q18"] = True
+
+QUERIES["q21"] = """
+select w_warehouse_name, i_item_id,
+       sum(case when d_date < date '2000-03-11' then inv_quantity_on_hand
+                else 0 end) as inv_before,
+       sum(case when d_date >= date '2000-03-11' then inv_quantity_on_hand
+                else 0 end) as inv_after
+from inventory, warehouse, item, date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = inv_item_sk
+  and inv_warehouse_sk = w_warehouse_sk
+  and inv_date_sk = d_date_sk
+  and d_date between date '2000-03-11' - interval '30' day
+                 and date '2000-03-11' + interval '30' day
+group by w_warehouse_name, i_item_id
+having (case when sum(case when d_date < date '2000-03-11'
+                           then inv_quantity_on_hand else 0 end) > 0
+             then 1.0 * sum(case when d_date >= date '2000-03-11'
+                            then inv_quantity_on_hand else 0 end)
+                  / sum(case when d_date < date '2000-03-11'
+                        then inv_quantity_on_hand else 0 end)
+             else null end) between 2.0 / 3.0 and 3.0 / 2.0
+order by w_warehouse_name, i_item_id
+limit 100
+"""
+ORDERED["q21"] = True
+
+QUERIES["q22"] = """
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 96 and 96 + 11
+group by rollup(i_product_name, i_brand, i_class, i_category)
+order by qoh, i_product_name, i_brand, i_class, i_category
+limit 100
+"""
+ORDERED["q22"] = True
+
+QUERIES["q27"] = """
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2000 and s_state in ('IL', 'MI')
+group by rollup (i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+"""
+ORDERED["q27"] = True
+
+QUERIES["q28"] = """
+select *
+from (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+             count(distinct ss_list_price) b1_cntd
+      from store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between 8 and 8 + 10
+             or ss_coupon_amt between 459 and 459 + 1000
+             or ss_wholesale_cost between 57 and 57 + 20)) b1,
+     (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+             count(distinct ss_list_price) b2_cntd
+      from store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between 90 and 90 + 10
+             or ss_coupon_amt between 2323 and 2323 + 1000
+             or ss_wholesale_cost between 31 and 31 + 20)) b2,
+     (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+             count(distinct ss_list_price) b3_cntd
+      from store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between 142 and 142 + 10
+             or ss_coupon_amt between 12214 and 12214 + 1000
+             or ss_wholesale_cost between 79 and 79 + 20)) b3,
+     (select avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+             count(distinct ss_list_price) b4_cntd
+      from store_sales
+      where ss_quantity between 16 and 20
+        and (ss_list_price between 135 and 135 + 10
+             or ss_coupon_amt between 6071 and 6071 + 1000
+             or ss_wholesale_cost between 38 and 38 + 20)) b4,
+     (select avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+             count(distinct ss_list_price) b5_cntd
+      from store_sales
+      where ss_quantity between 21 and 25
+        and (ss_list_price between 122 and 122 + 10
+             or ss_coupon_amt between 836 and 836 + 1000
+             or ss_wholesale_cost between 17 and 17 + 20)) b5,
+     (select avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+             count(distinct ss_list_price) b6_cntd
+      from store_sales
+      where ss_quantity between 26 and 30
+        and (ss_list_price between 154 and 154 + 10
+             or ss_coupon_amt between 7326 and 7326 + 1000
+             or ss_wholesale_cost between 7 and 7 + 20)) b6
+limit 100
+"""
+ORDERED["q28"] = True
+
+QUERIES["q30"] = """
+with customer_total_return as
+ (select wr_returning_customer_sk as ctr_customer_sk, ca_state as ctr_state,
+         sum(wr_return_amt) as ctr_total_return
+  from web_returns, date_dim, customer_address
+  where wr_returned_date_sk = d_date_sk and d_year = 2000
+    and wr_returning_addr_sk = ca_address_sk
+  group by wr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+       c_birth_country, c_login, c_email_address, c_last_review_date_sk,
+       ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk and ca_state = 'GA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+         c_birth_country, c_login, c_email_address, c_last_review_date_sk,
+         ctr_total_return
+limit 100
+"""
+ORDERED["q30"] = True
+
+# ---- round 4 batch 2: ratio self-joins, windows-over-aggregates ----------
+
+QUERIES["q31"] = """
+with ss as
+ (select ca_county, d_qoy, d_year, sum(ss_ext_sales_price) as store_sales
+  from store_sales, date_dim, customer_address
+  where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year),
+ ws as
+ (select ca_county, d_qoy, d_year, sum(ws_ext_sales_price) as web_sales
+  from web_sales, date_dim, customer_address
+  where ws_sold_date_sk = d_date_sk and ws_bill_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year)
+select ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase,
+       ws3.web_sales / ws2.web_sales web_q2_q3_increase,
+       ss3.store_sales / ss2.store_sales store_q2_q3_increase
+from ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+where ss1.d_qoy = 1 and ss1.d_year = 2000 and ss1.ca_county = ss2.ca_county
+  and ss2.d_qoy = 2 and ss2.d_year = 2000 and ss2.ca_county = ss3.ca_county
+  and ss3.d_qoy = 3 and ss3.d_year = 2000
+  and ss1.ca_county = ws1.ca_county and ws1.d_qoy = 1 and ws1.d_year = 2000
+  and ws1.ca_county = ws2.ca_county and ws2.d_qoy = 2 and ws2.d_year = 2000
+  and ws1.ca_county = ws3.ca_county and ws3.d_qoy = 3 and ws3.d_year = 2000
+  and case when ws1.web_sales > 0 then ws2.web_sales / ws1.web_sales else null end
+    > case when ss1.store_sales > 0 then ss2.store_sales / ss1.store_sales else null end
+  and case when ws2.web_sales > 0 then ws3.web_sales / ws2.web_sales else null end
+    > case when ss2.store_sales > 0 then ss3.store_sales / ss2.store_sales else null end
+order by ss1.ca_county
+"""
+ORDERED["q31"] = True
+
+QUERIES["q33"] = """
+with ss as
+ (select i_manufact_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 1
+    and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_manufact_id),
+ cs as
+ (select i_manufact_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 1
+    and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_manufact_id),
+ ws as
+ (select i_manufact_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 1
+    and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs union all select * from ws) tmp1
+group by i_manufact_id
+order by total_sales, i_manufact_id
+limit 100
+"""
+ORDERED["q33"] = False  # total_sales ties
+
+QUERIES["q35"] = """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) cnt1, avg(cd_dep_count) a1, max(cd_dep_count) m1, sum(cd_dep_count) s1,
+       cd_dep_employed_count, count(*) cnt2, avg(cd_dep_employed_count) a2,
+       max(cd_dep_employed_count) m2, sum(cd_dep_employed_count) s2,
+       cd_dep_college_count, count(*) cnt3, avg(cd_dep_college_count) a3,
+       max(cd_dep_college_count) m3, sum(cd_dep_college_count) s3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 2000
+                and d_qoy < 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk and d_year = 2000
+                 and d_qoy < 4)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk and d_year = 2000
+                    and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+"""
+ORDERED["q35"] = True
+
+QUERIES["q36"] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) as gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ss_net_profit) / sum(ss_ext_sales_price) asc)
+         as rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2000 and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+  and s_state in ('IL', 'MI')
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+ORDERED["q36"] = False  # rank ties within parent
+
+QUERIES["q39"] = """
+with inv as
+ (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy, stdev, mean,
+         case mean when 0 then null else stdev / mean end cov
+  from (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+               stddev_samp(inv_quantity_on_hand) stdev,
+               avg(inv_quantity_on_hand) mean
+        from inventory, item, warehouse, date_dim
+        where inv_item_sk = i_item_sk and inv_warehouse_sk = w_warehouse_sk
+          and inv_date_sk = d_date_sk and d_year = 2000
+        group by w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy) foo
+  where case mean when 0 then 0 else stdev / mean end > 1)
+select inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean, inv1.cov,
+       inv2.w_warehouse_sk w2, inv2.i_item_sk i2, inv2.d_moy m2, inv2.mean mean2,
+       inv2.cov cov2
+from inv inv1, inv inv2
+where inv1.i_item_sk = inv2.i_item_sk
+  and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  and inv1.d_moy = 1 and inv2.d_moy = 1 + 1
+order by inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean, inv1.cov,
+         inv2.d_moy, inv2.mean, inv2.cov
+"""
+ORDERED["q39"] = True
+
+QUERIES["q41"] = """
+select distinct i_product_name
+from item i1
+where i_manufact_id between 700 and 700 + 40
+  and (select count(*) as item_cnt
+       from item
+       where (i_manufact = i1.i_manufact
+              and ((i_category = 'Women'
+                    and (i_color = 'powder' or i_color = 'khaki')
+                    and (i_units = 'Ounce' or i_units = 'Oz')
+                    and (i_size = 'medium' or i_size = 'extra large'))
+                or (i_category = 'Women'
+                    and (i_color = 'brown' or i_color = 'honeydew')
+                    and (i_units = 'Bunch' or i_units = 'Ton')
+                    and (i_size = 'N/A' or i_size = 'small'))
+                or (i_category = 'Men'
+                    and (i_color = 'floral' or i_color = 'deep')
+                    and (i_units = 'N/A' or i_units = 'Dozen')
+                    and (i_size = 'petite' or i_size = 'large'))
+                or (i_category = 'Men'
+                    and (i_color = 'light' or i_color = 'cornflower')
+                    and (i_units = 'Box' or i_units = 'Pound')
+                    and (i_size = 'medium' or i_size = 'extra large'))))
+          or (i_manufact = i1.i_manufact
+              and ((i_category = 'Women'
+                    and (i_color = 'midnight' or i_color = 'snow')
+                    and (i_units = 'Pallet' or i_units = 'Gross')
+                    and (i_size = 'medium' or i_size = 'extra large'))
+                or (i_category = 'Women'
+                    and (i_color = 'cyan' or i_color = 'papaya')
+                    and (i_units = 'Cup' or i_units = 'Dram')
+                    and (i_size = 'N/A' or i_size = 'small'))
+                or (i_category = 'Men'
+                    and (i_color = 'orange' or i_color = 'frosted')
+                    and (i_units = 'Each' or i_units = 'Tbl')
+                    and (i_size = 'petite' or i_size = 'large'))
+                or (i_category = 'Men'
+                    and (i_color = 'forest' or i_color = 'ghost')
+                    and (i_units = 'Lb' or i_units = 'Bundle')
+                    and (i_size = 'medium' or i_size = 'extra large'))))) > 0
+order by i_product_name
+limit 100
+"""
+ORDERED["q41"] = True
+
+QUERIES["q44"] = """
+select asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from (select *
+      from (select item_sk, rank() over (order by rank_col asc) rnk
+            from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+                  from store_sales ss1
+                  where ss_store_sk = 1
+                  group by ss_item_sk
+                  having avg(ss_net_profit) > 0.9 *
+                    (select avg(ss_net_profit) rank_col
+                     from store_sales
+                     where ss_store_sk = 1 and ss_hdemo_sk is null
+                     group by ss_store_sk)) v1) v11
+      where rnk < 11) asceding,
+     (select *
+      from (select item_sk, rank() over (order by rank_col desc) rnk
+            from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+                  from store_sales ss1
+                  where ss_store_sk = 1
+                  group by ss_item_sk
+                  having avg(ss_net_profit) > 0.9 *
+                    (select avg(ss_net_profit) rank_col
+                     from store_sales
+                     where ss_store_sk = 1 and ss_hdemo_sk is null
+                     group by ss_store_sk)) v2) v21
+      where rnk < 11) descending,
+     item i1, item i2
+where asceding.rnk = descending.rnk
+  and i1.i_item_sk = asceding.item_sk
+  and i2.i_item_sk = descending.item_sk
+order by asceding.rnk
+"""
+ORDERED["q44"] = False  # rank ties make best/worst nondeterministic
+
+QUERIES["q45"] = """
+select ca_zip, ca_city, sum(ws_sales_price)
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405', '86475',
+                                '85392', '85460', '80348', '81792')
+       or i_item_id in (select i_item_id
+                        from item
+                        where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)))
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2000
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+"""
+ORDERED["q45"] = True
+
+QUERIES["q47"] = """
+with v1 as
+ (select i_category, i_brand, s_store_name, s_company_name, d_year, d_moy,
+         sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over
+           (partition by i_category, i_brand, s_store_name, s_company_name,
+                         d_year) avg_monthly_sales,
+         rank() over
+           (partition by i_category, i_brand, s_store_name, s_company_name
+            order by d_year, d_moy) rn
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and (d_year = 2000
+         or (d_year = 2000 - 1 and d_moy = 12)
+         or (d_year = 2000 + 1 and d_moy = 1))
+  group by i_category, i_brand, s_store_name, s_company_name, d_year, d_moy),
+ v2 as
+ (select v1.i_category, v1.i_brand, v1.s_store_name, v1.s_company_name,
+         v1.d_year, v1.d_moy, v1.avg_monthly_sales, v1.sum_sales,
+         v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+  from v1, v1 v1_lag, v1 v1_lead
+  where v1.i_category = v1_lag.i_category
+    and v1.i_category = v1_lead.i_category
+    and v1.i_brand = v1_lag.i_brand
+    and v1.i_brand = v1_lead.i_brand
+    and v1.s_store_name = v1_lag.s_store_name
+    and v1.s_store_name = v1_lead.s_store_name
+    and v1.s_company_name = v1_lag.s_company_name
+    and v1.s_company_name = v1_lead.s_company_name
+    and v1.rn = v1_lag.rn + 1
+    and v1.rn = v1_lead.rn - 1)
+select *
+from v2
+where d_year = 2000
+  and avg_monthly_sales > 0
+  and case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, 3
+limit 100
+"""
+ORDERED["q47"] = False  # ties in the sort expression
+
+QUERIES["q48"] = """
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'D'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00)
+    or (cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('CA', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('OR', 'MN', 'KY')
+        and ss_net_profit between 150 and 3000)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('VA', 'CA', 'MS')
+        and ss_net_profit between 50 and 25000))
+"""
+ORDERED["q48"] = True
+
+QUERIES["q49"] = """
+select channel, item, return_ratio, return_rank, currency_rank
+from (select 'web' as channel, web.item, web.return_ratio,
+             web.return_rank, web.currency_rank
+      from (select item, return_ratio, currency_ratio,
+                   rank() over (order by return_ratio) as return_rank,
+                   rank() over (order by currency_ratio) as currency_rank
+            from (select ws.ws_item_sk as item,
+                         (cast(sum(coalesce(wr.wr_return_quantity, 0)) as double) /
+                          cast(sum(coalesce(ws.ws_quantity, 0)) as double)) as return_ratio,
+                         (cast(sum(coalesce(wr.wr_return_amt, 0)) as double) /
+                          cast(sum(coalesce(ws.ws_net_paid, 0)) as double)) as currency_ratio
+                  from web_sales ws
+                       left outer join web_returns wr
+                         on (ws.ws_order_number = wr.wr_order_number
+                             and ws.ws_item_sk = wr.wr_item_sk),
+                       date_dim
+                  where wr.wr_return_amt > 100
+                    and ws.ws_net_profit > 1
+                    and ws.ws_net_paid > 0
+                    and ws.ws_quantity > 0
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2000 and d_moy = 12
+                  group by ws.ws_item_sk) in_web) web
+      where web.return_rank <= 10 or web.currency_rank <= 10
+      union
+      select 'catalog' as channel, catalog.item, catalog.return_ratio,
+             catalog.return_rank, catalog.currency_rank
+      from (select item, return_ratio, currency_ratio,
+                   rank() over (order by return_ratio) as return_rank,
+                   rank() over (order by currency_ratio) as currency_rank
+            from (select cs.cs_item_sk as item,
+                         (cast(sum(coalesce(cr.cr_return_quantity, 0)) as double) /
+                          cast(sum(coalesce(cs.cs_quantity, 0)) as double)) as return_ratio,
+                         (cast(sum(coalesce(cr.cr_return_amount, 0)) as double) /
+                          cast(sum(coalesce(cs.cs_net_paid, 0)) as double)) as currency_ratio
+                  from catalog_sales cs
+                       left outer join catalog_returns cr
+                         on (cs.cs_order_number = cr.cr_order_number
+                             and cs.cs_item_sk = cr.cr_item_sk),
+                       date_dim
+                  where cr.cr_return_amount > 100
+                    and cs.cs_net_profit > 1
+                    and cs.cs_net_paid > 0
+                    and cs.cs_quantity > 0
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2000 and d_moy = 12
+                  group by cs.cs_item_sk) in_cat) catalog
+      where catalog.return_rank <= 10 or catalog.currency_rank <= 10
+      union
+      select 'store' as channel, store.item, store.return_ratio,
+             store.return_rank, store.currency_rank
+      from (select item, return_ratio, currency_ratio,
+                   rank() over (order by return_ratio) as return_rank,
+                   rank() over (order by currency_ratio) as currency_rank
+            from (select sts.ss_item_sk as item,
+                         (cast(sum(coalesce(sr.sr_return_quantity, 0)) as double) /
+                          cast(sum(coalesce(sts.ss_quantity, 0)) as double)) as return_ratio,
+                         (cast(sum(coalesce(sr.sr_return_amt, 0)) as double) /
+                          cast(sum(coalesce(sts.ss_net_paid, 0)) as double)) as currency_ratio
+                  from store_sales sts
+                       left outer join store_returns sr
+                         on (sts.ss_ticket_number = sr.sr_ticket_number
+                             and sts.ss_item_sk = sr.sr_item_sk),
+                       date_dim
+                  where sr.sr_return_amt > 100
+                    and sts.ss_net_profit > 1
+                    and sts.ss_net_paid > 0
+                    and sts.ss_quantity > 0
+                    and ss_sold_date_sk = d_date_sk
+                    and d_year = 2000 and d_moy = 12
+                  group by sts.ss_item_sk) in_store) store
+      where store.return_rank <= 10 or store.currency_rank <= 10) sq1
+order by 1, 4, 5, 2
+limit 100
+"""
+ORDERED["q49"] = False  # rank ties
+
+QUERIES["q51"] = """
+with web_v1 as
+ (select ws_item_sk item_sk, d_date,
+         sum(sum(ws_sales_price)) over
+           (partition by ws_item_sk order by d_date
+            rows between unbounded preceding and current row) cume_sales
+  from web_sales, date_dim
+  where ws_sold_date_sk = d_date_sk and d_month_seq between 96 and 96 + 11
+    and ws_item_sk is not null
+  group by ws_item_sk, d_date),
+ store_v1 as
+ (select ss_item_sk item_sk, d_date,
+         sum(sum(ss_sales_price)) over
+           (partition by ss_item_sk order by d_date
+            rows between unbounded preceding and current row) cume_sales
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk and d_month_seq between 96 and 96 + 11
+    and ss_item_sk is not null
+  group by ss_item_sk, d_date)
+select *
+from (select item_sk, d_date, web_sales, store_sales,
+             max(web_sales) over
+               (partition by item_sk order by d_date
+                rows between unbounded preceding and current row) web_cumulative,
+             max(store_sales) over
+               (partition by item_sk order by d_date
+                rows between unbounded preceding and current row) store_cumulative
+      from (select case when web.item_sk is not null then web.item_sk
+                        else store.item_sk end item_sk,
+                   case when web.d_date is not null then web.d_date
+                        else store.d_date end d_date,
+                   web.cume_sales web_sales,
+                   store.cume_sales store_sales
+            from web_v1 web full outer join store_v1 store
+              on (web.item_sk = store.item_sk and web.d_date = store.d_date)) x) y
+where web_cumulative > store_cumulative
+order by item_sk, d_date
+limit 100
+"""
+ORDERED["q51"] = True
+
+# ---- round 4 batch 3: channel unions, time buckets, yoy ratios -----------
+
+QUERIES["q53"] = """
+select *
+from (select i_manufact_id, sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price)) over (partition by i_manufact_id)
+               avg_quarterly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_month_seq in (96, 96 + 1, 96 + 2, 96 + 3, 96 + 4, 96 + 5,
+                            96 + 6, 96 + 7, 96 + 8, 96 + 9, 96 + 10, 96 + 11)
+        and ((i_category in ('Books', 'Children', 'Electronics')
+              and i_class in ('personal', 'portable', 'reference', 'self-help'))
+          or (i_category in ('Women', 'Music', 'Men')
+              and i_class in ('accessories', 'classical', 'fragrances', 'pants')))
+      group by i_manufact_id, d_qoy) tmp1
+where case when avg_quarterly_sales > 0
+           then abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+           else null end > 0.1
+order by avg_quarterly_sales, sum_sales, i_manufact_id
+limit 100
+"""
+ORDERED["q53"] = False  # ties
+
+QUERIES["q56"] = """
+with ss as
+ (select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('red', 'green', 'blue'))
+    and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 2
+    and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id),
+ cs as
+ (select i_item_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('red', 'green', 'blue'))
+    and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 2
+    and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id),
+ ws as
+ (select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('red', 'green', 'blue'))
+    and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 2
+    and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs union all select * from ws) tmp1
+group by i_item_id
+order by total_sales, i_item_id
+limit 100
+"""
+ORDERED["q56"] = False
+
+QUERIES["q57"] = """
+with v1 as
+ (select i_category, i_brand, cc_name, d_year, d_moy,
+         sum(cs_sales_price) sum_sales,
+         avg(sum(cs_sales_price)) over
+           (partition by i_category, i_brand, cc_name, d_year)
+           avg_monthly_sales,
+         rank() over
+           (partition by i_category, i_brand, cc_name
+            order by d_year, d_moy) rn
+  from item, catalog_sales, date_dim, call_center
+  where cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and cc_call_center_sk = cs_call_center_sk
+    and (d_year = 2000
+         or (d_year = 2000 - 1 and d_moy = 12)
+         or (d_year = 2000 + 1 and d_moy = 1))
+  group by i_category, i_brand, cc_name, d_year, d_moy),
+ v2 as
+ (select v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+         v1.avg_monthly_sales, v1.sum_sales,
+         v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+  from v1, v1 v1_lag, v1 v1_lead
+  where v1.i_category = v1_lag.i_category
+    and v1.i_category = v1_lead.i_category
+    and v1.i_brand = v1_lag.i_brand and v1.i_brand = v1_lead.i_brand
+    and v1.cc_name = v1_lag.cc_name and v1.cc_name = v1_lead.cc_name
+    and v1.rn = v1_lag.rn + 1 and v1.rn = v1_lead.rn - 1)
+select *
+from v2
+where d_year = 2000
+  and avg_monthly_sales > 0
+  and case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, 3
+limit 100
+"""
+ORDERED["q57"] = False
+
+QUERIES["q58"] = """
+with ss_items as
+ (select i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+  from store_sales, item, date_dim
+  where ss_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq = (select d_week_seq from date_dim
+                                       where d_date = '2000-02-21'))
+    and ss_sold_date_sk = d_date_sk
+  group by i_item_id),
+ cs_items as
+ (select i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+  from catalog_sales, item, date_dim
+  where cs_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq = (select d_week_seq from date_dim
+                                       where d_date = '2000-02-21'))
+    and cs_sold_date_sk = d_date_sk
+  group by i_item_id),
+ ws_items as
+ (select i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+  from web_sales, item, date_dim
+  where ws_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq = (select d_week_seq from date_dim
+                                       where d_date = '2000-02-21'))
+    and ws_sold_date_sk = d_date_sk
+  group by i_item_id)
+select ss_items.item_id,
+       ss_item_rev,
+       ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 ss_dev,
+       cs_item_rev,
+       cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 cs_dev,
+       ws_item_rev,
+       ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100 ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average
+from ss_items, cs_items, ws_items
+where ss_items.item_id = cs_items.item_id
+  and ss_items.item_id = ws_items.item_id
+  and ss_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+  and ss_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+  and cs_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+  and cs_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+  and ws_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+  and ws_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+order by item_id, ss_item_rev
+limit 100
+"""
+ORDERED["q58"] = True
+
+QUERIES["q59"] = """
+with wss as
+ (select d_week_seq, ss_store_sk,
+        sum(case when (d_day_name = 'Sunday') then ss_sales_price else null end) sun_sales,
+        sum(case when (d_day_name = 'Monday') then ss_sales_price else null end) mon_sales,
+        sum(case when (d_day_name = 'Tuesday') then ss_sales_price else null end) tue_sales,
+        sum(case when (d_day_name = 'Wednesday') then ss_sales_price else null end) wed_sales,
+        sum(case when (d_day_name = 'Thursday') then ss_sales_price else null end) thu_sales,
+        sum(case when (d_day_name = 'Friday') then ss_sales_price else null end) fri_sales,
+        sum(case when (d_day_name = 'Saturday') then ss_sales_price else null end) sat_sales
+ from store_sales, date_dim
+ where d_date_sk = ss_sold_date_sk
+ group by d_week_seq, ss_store_sk)
+select s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2, mon_sales1 / mon_sales2,
+       tue_sales1 / tue_sales2, wed_sales1 / wed_sales2,
+       thu_sales1 / thu_sales2, fri_sales1 / fri_sales2,
+       sat_sales1 / sat_sales2
+from (select s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             s_store_id s_store_id1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+        and d_month_seq between 96 and 96 + 11) y,
+     (select s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             s_store_id s_store_id2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+        and d_month_seq between 96 + 12 and 96 + 23) x
+where s_store_id1 = s_store_id2
+  and d_week_seq1 = d_week_seq2 - 52
+order by s_store_name1, s_store_id1, d_week_seq1
+limit 100
+"""
+ORDERED["q59"] = True
+
+QUERIES["q60"] = """
+with ss as
+ (select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item where i_category in ('Music'))
+    and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 9
+    and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id),
+ cs as
+ (select i_item_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item where i_category in ('Music'))
+    and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 9
+    and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id),
+ ws as
+ (select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item where i_category in ('Music'))
+    and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 2000 and d_moy = 9
+    and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs union all select * from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+"""
+ORDERED["q60"] = True
+
+QUERIES["q61"] = """
+select promotions, total, cast(promotions as double) / cast(total as double) * 100
+from (select sum(ss_ext_sales_price) promotions
+      from store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_promo_sk = p_promo_sk and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5 and i_category = 'Jewelry'
+        and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+             or p_channel_tv = 'Y')
+        and s_gmt_offset = -5 and d_year = 2000 and d_moy = 11) promotional_sales,
+     (select sum(ss_ext_sales_price) total
+      from store_sales, store, date_dim, customer, customer_address, item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5 and i_category = 'Jewelry'
+        and s_gmt_offset = -5 and d_year = 2000 and d_moy = 11) all_sales
+order by promotions, total
+limit 100
+"""
+ORDERED["q61"] = True
+
+QUERIES["q62"] = """
+select substring(w_warehouse_name, 1, 20) as wname, sm_type, web_name,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk <= 30) then 1 else 0 end) as d30,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 30)
+            and (ws_ship_date_sk - ws_sold_date_sk <= 60) then 1 else 0 end) as d60,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60)
+            and (ws_ship_date_sk - ws_sold_date_sk <= 90) then 1 else 0 end) as d90,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 90)
+            and (ws_ship_date_sk - ws_sold_date_sk <= 120) then 1 else 0 end) as d120,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 120) then 1 else 0 end) as d120plus
+from web_sales, warehouse, ship_mode, web_site, date_dim
+where d_month_seq between 96 and 96 + 11
+  and ws_ship_date_sk = d_date_sk
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by substring(w_warehouse_name, 1, 20), sm_type, web_name
+order by wname, sm_type, web_name
+limit 100
+"""
+ORDERED["q62"] = True
+
+QUERIES["q63"] = """
+select *
+from (select i_manager_id, sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price)) over (partition by i_manager_id)
+               avg_monthly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_month_seq in (96, 96 + 1, 96 + 2, 96 + 3, 96 + 4, 96 + 5,
+                            96 + 6, 96 + 7, 96 + 8, 96 + 9, 96 + 10, 96 + 11)
+        and ((i_category in ('Books', 'Children', 'Electronics')
+              and i_class in ('personal', 'portable', 'reference', 'self-help'))
+          or (i_category in ('Women', 'Music', 'Men')
+              and i_class in ('accessories', 'classical', 'fragrances', 'pants')))
+      group by i_manager_id, d_moy) tmp1
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+"""
+ORDERED["q63"] = False
+
+QUERIES["q66"] = """
+select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+       w_country, ship_carriers, year_,
+       sum(jan_sales) as jan_sales, sum(feb_sales) as feb_sales,
+       sum(mar_sales) as mar_sales, sum(apr_sales) as apr_sales,
+       sum(may_sales) as may_sales, sum(jun_sales) as jun_sales,
+       sum(jul_sales) as jul_sales, sum(aug_sales) as aug_sales,
+       sum(sep_sales) as sep_sales, sum(oct_sales) as oct_sales,
+       sum(nov_sales) as nov_sales, sum(dec_sales) as dec_sales,
+       sum(jan_net) as jan_net, sum(feb_net) as feb_net,
+       sum(mar_net) as mar_net, sum(apr_net) as apr_net,
+       sum(may_net) as may_net, sum(jun_net) as jun_net,
+       sum(jul_net) as jul_net, sum(aug_net) as aug_net,
+       sum(sep_net) as sep_net, sum(oct_net) as oct_net,
+       sum(nov_net) as nov_net, sum(dec_net) as dec_net
+from (
+    select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+           w_country, 'DHL,BARIAN' as ship_carriers, d_year as year_,
+           sum(case when d_moy = 1 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as jan_sales,
+           sum(case when d_moy = 2 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as feb_sales,
+           sum(case when d_moy = 3 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as mar_sales,
+           sum(case when d_moy = 4 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as apr_sales,
+           sum(case when d_moy = 5 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as may_sales,
+           sum(case when d_moy = 6 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as jun_sales,
+           sum(case when d_moy = 7 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as jul_sales,
+           sum(case when d_moy = 8 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as aug_sales,
+           sum(case when d_moy = 9 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as sep_sales,
+           sum(case when d_moy = 10 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as oct_sales,
+           sum(case when d_moy = 11 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as nov_sales,
+           sum(case when d_moy = 12 then ws_ext_sales_price * ws_quantity
+                    else 0 end) as dec_sales,
+           sum(case when d_moy = 1 then ws_net_paid * ws_quantity else 0 end) as jan_net,
+           sum(case when d_moy = 2 then ws_net_paid * ws_quantity else 0 end) as feb_net,
+           sum(case when d_moy = 3 then ws_net_paid * ws_quantity else 0 end) as mar_net,
+           sum(case when d_moy = 4 then ws_net_paid * ws_quantity else 0 end) as apr_net,
+           sum(case when d_moy = 5 then ws_net_paid * ws_quantity else 0 end) as may_net,
+           sum(case when d_moy = 6 then ws_net_paid * ws_quantity else 0 end) as jun_net,
+           sum(case when d_moy = 7 then ws_net_paid * ws_quantity else 0 end) as jul_net,
+           sum(case when d_moy = 8 then ws_net_paid * ws_quantity else 0 end) as aug_net,
+           sum(case when d_moy = 9 then ws_net_paid * ws_quantity else 0 end) as sep_net,
+           sum(case when d_moy = 10 then ws_net_paid * ws_quantity else 0 end) as oct_net,
+           sum(case when d_moy = 11 then ws_net_paid * ws_quantity else 0 end) as nov_net,
+           sum(case when d_moy = 12 then ws_net_paid * ws_quantity else 0 end) as dec_net
+    from web_sales, warehouse, date_dim, time_dim, ship_mode
+    where ws_warehouse_sk = w_warehouse_sk and ws_sold_date_sk = d_date_sk
+      and ws_sold_time_sk = t_time_sk and ws_ship_mode_sk = sm_ship_mode_sk
+      and d_year = 2000 and t_time between 30838 and 30838 + 28800
+      and sm_carrier in ('DHL', 'BARIAN')
+    group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+             w_country, d_year
+    union all
+    select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+           w_country, 'DHL,BARIAN' as ship_carriers, d_year as year_,
+           sum(case when d_moy = 1 then cs_sales_price * cs_quantity
+                    else 0 end) as jan_sales,
+           sum(case when d_moy = 2 then cs_sales_price * cs_quantity
+                    else 0 end) as feb_sales,
+           sum(case when d_moy = 3 then cs_sales_price * cs_quantity
+                    else 0 end) as mar_sales,
+           sum(case when d_moy = 4 then cs_sales_price * cs_quantity
+                    else 0 end) as apr_sales,
+           sum(case when d_moy = 5 then cs_sales_price * cs_quantity
+                    else 0 end) as may_sales,
+           sum(case when d_moy = 6 then cs_sales_price * cs_quantity
+                    else 0 end) as jun_sales,
+           sum(case when d_moy = 7 then cs_sales_price * cs_quantity
+                    else 0 end) as jul_sales,
+           sum(case when d_moy = 8 then cs_sales_price * cs_quantity
+                    else 0 end) as aug_sales,
+           sum(case when d_moy = 9 then cs_sales_price * cs_quantity
+                    else 0 end) as sep_sales,
+           sum(case when d_moy = 10 then cs_sales_price * cs_quantity
+                    else 0 end) as oct_sales,
+           sum(case when d_moy = 11 then cs_sales_price * cs_quantity
+                    else 0 end) as nov_sales,
+           sum(case when d_moy = 12 then cs_sales_price * cs_quantity
+                    else 0 end) as dec_sales,
+           sum(case when d_moy = 1 then cs_net_paid_inc_tax * cs_quantity else 0 end) as jan_net,
+           sum(case when d_moy = 2 then cs_net_paid_inc_tax * cs_quantity else 0 end) as feb_net,
+           sum(case when d_moy = 3 then cs_net_paid_inc_tax * cs_quantity else 0 end) as mar_net,
+           sum(case when d_moy = 4 then cs_net_paid_inc_tax * cs_quantity else 0 end) as apr_net,
+           sum(case when d_moy = 5 then cs_net_paid_inc_tax * cs_quantity else 0 end) as may_net,
+           sum(case when d_moy = 6 then cs_net_paid_inc_tax * cs_quantity else 0 end) as jun_net,
+           sum(case when d_moy = 7 then cs_net_paid_inc_tax * cs_quantity else 0 end) as jul_net,
+           sum(case when d_moy = 8 then cs_net_paid_inc_tax * cs_quantity else 0 end) as aug_net,
+           sum(case when d_moy = 9 then cs_net_paid_inc_tax * cs_quantity else 0 end) as sep_net,
+           sum(case when d_moy = 10 then cs_net_paid_inc_tax * cs_quantity else 0 end) as oct_net,
+           sum(case when d_moy = 11 then cs_net_paid_inc_tax * cs_quantity else 0 end) as nov_net,
+           sum(case when d_moy = 12 then cs_net_paid_inc_tax * cs_quantity else 0 end) as dec_net
+    from catalog_sales, warehouse, date_dim, time_dim, ship_mode
+    where cs_warehouse_sk = w_warehouse_sk and cs_sold_date_sk = d_date_sk
+      and cs_sold_time_sk = t_time_sk and cs_ship_mode_sk = sm_ship_mode_sk
+      and d_year = 2000 and t_time between 30838 and 30838 + 28800
+      and sm_carrier in ('DHL', 'BARIAN')
+    group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+             w_country, d_year) x
+group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+         w_country, ship_carriers, year_
+order by w_warehouse_name
+limit 100
+"""
+ORDERED["q66"] = True
+
+QUERIES["q68"] = """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and d_dom between 1 and 2
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+        and d_year in (1999, 1999 + 1, 1999 + 2)
+        and s_city in ('Midway', 'Fairview')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+"""
+ORDERED["q68"] = False  # c_last_name ties
+
+QUERIES["q69"] = """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('CA', 'GA', 'TX')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 2000
+                and d_moy between 1 and 1 + 2)
+  and not exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk and d_year = 2000
+                    and d_moy between 1 and 1 + 2)
+  and not exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk and d_year = 2000
+                    and d_moy between 1 and 1 + 2)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+limit 100
+"""
+ORDERED["q69"] = True
+
+QUERIES["q71"] = """
+select i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+from item,
+     (select ws_ext_sales_price as ext_price, ws_sold_date_sk as sold_date_sk,
+             ws_item_sk as sold_item_sk, ws_sold_time_sk as time_sk
+      from web_sales, date_dim
+      where d_date_sk = ws_sold_date_sk and d_moy = 11 and d_year = 2000
+      union all
+      select cs_ext_sales_price as ext_price, cs_sold_date_sk as sold_date_sk,
+             cs_item_sk as sold_item_sk, cs_sold_time_sk as time_sk
+      from catalog_sales, date_dim
+      where d_date_sk = cs_sold_date_sk and d_moy = 11 and d_year = 2000
+      union all
+      select ss_ext_sales_price as ext_price, ss_sold_date_sk as sold_date_sk,
+             ss_item_sk as sold_item_sk, ss_sold_time_sk as time_sk
+      from store_sales, date_dim
+      where d_date_sk = ss_sold_date_sk and d_moy = 11 and d_year = 2000) tmp,
+     time_dim
+where sold_item_sk = i_item_sk and i_manager_id = 1
+  and time_sk = t_time_sk
+  and (t_meal_time = 'breakfast' or t_meal_time = 'dinner')
+group by i_brand, i_brand_id, t_hour, t_minute
+order by ext_price desc, i_brand_id
+"""
+ORDERED["q71"] = False  # ext_price ties

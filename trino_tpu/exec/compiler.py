@@ -360,7 +360,7 @@ class LocalExecutor:
                 caps[nid] = min(_pow2(max(cap, 1024)), _pow2(max(child_sizes[0], 1)))
                 return caps[nid]
             if isinstance(n, Join):
-                if n.kind in ("semi", "anti", "null_anti"):
+                if n.kind in ("semi", "anti", "null_anti", "mark", "mark_in"):
                     caps[nid] = _pow2(max(max(child_sizes), 1))
                     return child_sizes[0]
                 if n.kind == "cross":

@@ -148,7 +148,7 @@ class SpmdExecutor(LocalExecutor):
                 if n.kind == "cross":
                     return child_sizes[0]
                 hard = _pow2(max(max(child_sizes), 1))
-                if n.kind in ("semi", "anti", "null_anti"):
+                if n.kind in ("semi", "anti", "null_anti", "mark", "mark_in"):
                     caps[nid] = hard
                     return child_sizes[0]
                 # stats-sized expansion frame per device (same rationale as

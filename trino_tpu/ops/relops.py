@@ -893,6 +893,20 @@ _SENT_BUILD = (1 << 62) + 2  # sorts after every real hash
 _SENT_PROBE = (1 << 62) + 1  # != build sentinel -> dead probes match nothing
 
 
+def _in_null_facts(left_keys, right_keys, left_live, right_live, nl, nr):
+    """The three facts SQL IN's three-valued logic turns on: does the build
+    side have any live row, does it hold a NULL key, is the probe key
+    non-NULL.  Shared by null_anti (NOT IN filter) and mark_in (IN column)."""
+    build_any = jnp.any(right_live)
+    build_has_null = jnp.zeros((), jnp.bool_)
+    probe_ok = jnp.ones((nl,), jnp.bool_)
+    for rk in right_keys:
+        build_has_null = build_has_null | jnp.any(right_live & ~_valid_of(rk, nr))
+    for lk in left_keys:
+        probe_ok = probe_ok & _valid_of(lk, nl)
+    return build_any, build_has_null, probe_ok
+
+
 def equi_join(
     kind: str,
     left_cols: Sequence[ColumnVal],
@@ -915,6 +929,13 @@ def equi_join(
       non-empty build side, probe rows whose key is NULL — or any probe row
       when the build side contains a NULL key — evaluate NOT IN to NULL and
       are filtered; an empty build side keeps every probe row.
+    mark / mark_in -> (left_cols + [match BOOLEAN column], left_live,
+      required): the membership test becomes a COLUMN instead of a filter —
+      the lowering for EXISTS / IN in general expression positions (OR'd
+      predicates, select items; reference: SemiJoinNode's
+      semiJoinOutput symbol).  mark is two-valued (EXISTS); mark_in is
+      SQL three-valued: NULL when the probe key is NULL or the build side
+      holds a NULL key and there is no match (an empty build is FALSE).
     `required` is the true expansion size for the host's retry loop.
     """
     nl = left_live.shape[0]
@@ -978,6 +999,22 @@ def equi_join(
 
     required = total
 
+    if kind in ("mark", "mark_in"):
+        from ..data.types import BOOLEAN
+
+        hit = jnp.zeros((nl,), jnp.bool_).at[pidx_c].max(match, mode="drop")
+        if kind == "mark":
+            mark = ColumnVal(hit, None, None, BOOLEAN)
+        else:
+            build_any, build_has_null, probe_ok = _in_null_facts(
+                left_keys, right_keys, left_live, right_live, nl, nr
+            )
+            # TRUE on match; else FALSE when definitively absent (non-null
+            # probe, no build NULLs, or empty build); else NULL (unknown)
+            definite = hit | ~build_any | (probe_ok & ~build_has_null)
+            mark = ColumnVal(hit, definite, None, BOOLEAN)
+        return list(left_cols) + [mark], left_live, required
+
     if kind in ("semi", "anti", "null_anti"):
         hit = jnp.zeros((nl,), jnp.bool_).at[pidx_c].max(match, mode="drop")
         if kind == "semi":
@@ -985,15 +1022,9 @@ def equi_join(
         elif kind == "anti":
             new_live = left_live & ~hit
         else:  # null_anti: SQL three-valued NOT IN
-            build_any = jnp.any(right_live)
-            build_has_null = jnp.zeros((), jnp.bool_)
-            probe_ok = jnp.ones((nl,), jnp.bool_)
-            for rk in right_keys:
-                build_has_null = build_has_null | jnp.any(
-                    right_live & ~_valid_of(rk, nr)
-                )
-            for lk in left_keys:
-                probe_ok = probe_ok & _valid_of(lk, nl)
+            build_any, build_has_null, probe_ok = _in_null_facts(
+                left_keys, right_keys, left_live, right_live, nl, nr
+            )
             keep = jnp.where(
                 build_any, ~hit & probe_ok & ~build_has_null, True
             )

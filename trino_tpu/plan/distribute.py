@@ -381,6 +381,9 @@ class _Distributor:
             # hash-partitioned build (NULLs routed to partition 0) would
             # give partition-local answers.
             or node.kind == "null_anti"
+            # mark_in shares null_anti's need for a global build view (its
+            # FALSE-vs-NULL answer depends on build emptiness and NULLs)
+            or node.kind == "mark_in"
         )
         if node.kind == "full":
             # a replicated build would emit its unmatched rows once PER

@@ -165,12 +165,18 @@ class Join(PlanNode):
     def output_names(self):
         if self.kind in ("semi", "anti", "null_anti"):
             return self.left.output_names
+        if self.kind in ("mark", "mark_in"):
+            return self.left.output_names + ("$mark",)
         return self.left.output_names + self.right.output_names
 
     @property
     def output_types(self):
+        from ..data.types import BOOLEAN
+
         if self.kind in ("semi", "anti", "null_anti"):
             return self.left.output_types
+        if self.kind in ("mark", "mark_in"):
+            return self.left.output_types + (BOOLEAN,)
         return self.left.output_types + self.right.output_types
 
 

@@ -83,9 +83,12 @@ def push_filters(plan: PlanNode) -> PlanNode:
             keep: list[IrExpr] = []
             for p in preds:
                 refs = field_refs(p)
-                if node.kind in ("inner", "semi", "anti", "null_anti", "cross"):
+                if node.kind in ("inner", "semi", "anti", "null_anti", "cross",
+                                 "mark", "mark_in"):
                     # semi/anti output IS the left schema; filtering left rows
-                    # commutes with the (anti-)membership test
+                    # commutes with the (anti-)membership test (mark joins:
+                    # left-field predicates commute, the $mark column at
+                    # index nl stays behind the `keep` guard)
                     if all(i < nl for i in refs):
                         lp.append(p)
                     elif node.kind == "inner" and refs and all(i >= nl for i in refs):
@@ -199,7 +202,7 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         left_needed = {i for i in needed if i < nl}
         right_needed = (
             set()
-            if node.kind in ("semi", "anti", "null_anti")
+            if node.kind in ("semi", "anti", "null_anti", "mark", "mark_in")
             else {i - nl for i in needed if i >= nl}
         )
         for k in node.left_keys:
@@ -229,6 +232,11 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         )
         if node.kind in ("semi", "anti", "null_anti"):
             return new, ml
+        if node.kind in ("mark", "mark_in"):
+            # the $mark column rides at index nl -> new_nl after pruning
+            mark_map = dict(ml)
+            mark_map[nl] = new_nl
+            return new, mark_map
         return new, concat_map
 
     if isinstance(node, (Sort, TopN)):

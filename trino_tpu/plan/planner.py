@@ -257,8 +257,19 @@ class Planner:
             merged.update(win_map)
             translator = _Translator(rel.scope, outer, agg_map=merged, grouped=was_grouped)
 
-        # 4. SELECT projection
+        # 4. SELECT projection — subqueries in select items (scalar
+        # subqueries, EXISTS/IN as boolean expressions) lower to appended
+        # join columns first (TPC-DS q09's CASE over scalar subqueries)
         items = self._expand_stars(sel.items, rel)
+        if any(_has_subquery(it.expr) for it in items):
+            rel, sub_map = self._lower_subquery_exprs(
+                rel, [it.expr for it in items], outer, ctes, translator
+            )
+            merged = dict(translator.agg_map or {})
+            merged.update(sub_map)
+            translator = _Translator(
+                rel.scope, outer, agg_map=merged, grouped=translator.grouped
+            )
         exprs: list[IrExpr] = []
         names: list[str] = []
         for it in items:
@@ -281,7 +292,7 @@ class Planner:
                         "for SELECT DISTINCT, ORDER BY expressions must "
                         "appear in the select list"
                     )
-                t_ir = translator.translate(si.expr)
+                t_ir = translator.translate(_substitute_aliases(si.expr, items))
                 k = FieldRef(len(exprs) + len(hidden), t_ir.type)
                 hidden.append(t_ir)
             sort_keys.append(SortKey(k, si.ascending, _nulls_first(si)))
@@ -332,7 +343,11 @@ class Planner:
             if it.expr == e:
                 return FieldRef(i, exprs[i].type)
         # expression over the pre-projection scope that coincides with a
-        # select expression after translation
+        # select expression after translation; select aliases may appear
+        # INSIDE the expression (`order by case when lochierarchy = 0 ...`,
+        # TPC-DS q36/q70/q86) — substitute them first (the reference resolves
+        # aliases in ORDER BY scope, sql/analyzer/OrderByExpressionRewriter)
+        e = _substitute_aliases(e, items)
         translated = translator.translate(e)
         for i, ex in enumerate(exprs):
             if ex == translated:
@@ -1170,7 +1185,112 @@ class Planner:
                 return self._plan_scalar_cmp(
                     rel, rh, _CMP_FLIP[_CMP_OPS[e.op]], lh.query, outer, ctes, translator
                 )
-        raise PlanningError(f"unsupported subquery predicate: {c}")
+        # general boolean combinations (EXISTS / IN under OR, subqueries in
+        # scalar positions): mark-join lowering, then an ordinary filter over
+        # the substituted predicate
+        base_fields = rel.fields
+        rel2, sub_map = self._lower_subquery_exprs(rel, [c], outer, ctes, translator)
+        merged = dict(translator.agg_map or {})
+        merged.update(sub_map)
+        t2 = _Translator(rel2.scope, outer, agg_map=merged, grouped=translator.grouped)
+        pred = _as_bool(t2.translate(c))
+        filtered = Filter(rel2.node, pred)
+        proj_back = Project(
+            filtered,
+            tuple(FieldRef(i, f.type) for i, f in enumerate(base_fields)),
+            tuple(f.name or f"_c{i}" for i, f in enumerate(base_fields)),
+        )
+        return RelationPlan(proj_back, base_fields)
+
+    def _lower_subquery_exprs(
+        self,
+        rel: RelationPlan,
+        exprs: Sequence[A.Expr],
+        outer: Optional[Scope],
+        ctes: dict[str, A.Query],
+        translator: Optional["_Translator"] = None,
+    ) -> tuple[RelationPlan, dict[A.Expr, IrExpr]]:
+        """Rewrite subqueries in general expression positions into appended
+        columns over `rel`: uncorrelated scalar subqueries become
+        EnforceSingleRow cross joins, EXISTS / IN become mark joins producing
+        a BOOLEAN column (reference: SemiJoinNode's semiJoinOutput symbol +
+        EnforceSingleRowOperator).  Returns the widened relation and an
+        AST -> IR substitution map; field indices of the original relation
+        are unchanged (columns only append)."""
+        from .nodes import EnforceSingleRow
+
+        sub_map: dict[A.Expr, IrExpr] = {}
+        found: list[A.Expr] = []
+
+        def collect(e: A.Expr) -> None:
+            if isinstance(e, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+                found.append(e)
+                return  # do not descend into the subquery itself
+            for ch in _ast_children(e):
+                collect(ch)
+
+        for e in exprs:
+            collect(e)
+
+        for node_ast in found:
+            if node_ast in sub_map:
+                continue
+            outer_scope = Scope(rel.fields, outer)
+            merged = dict(translator.agg_map or {}) if translator else {}
+            merged.update(sub_map)
+            grouped = translator.grouped if translator else False
+            t = _Translator(
+                Scope(rel.fields, outer), outer,
+                agg_map=merged or None, grouped=grouped,
+            )
+            if isinstance(node_ast, A.ScalarSubquery):
+                sub = self._plan_subquery_relation(node_ast.query, outer_scope, ctes)
+                if len(sub.fields) != 1:
+                    raise PlanningError("scalar subquery must select one expression")
+                node = Join(
+                    "cross", rel.node, EnforceSingleRow(sub.node), (), (), None
+                )
+                ref = FieldRef(len(rel.fields), sub.fields[0].type)
+                rel = RelationPlan(
+                    node, rel.fields + [Field(None, None, sub.fields[0].type)]
+                )
+                sub_map[node_ast] = ref
+                continue
+            if isinstance(node_ast, A.InSubquery):
+                sub = self._plan_subquery_relation(node_ast.query, outer_scope, ctes)
+                if len(sub.fields) != 1:
+                    raise PlanningError("IN subquery must produce one column")
+                lkey = t.translate(node_ast.operand)
+                rkey = FieldRef(0, sub.fields[0].type)
+                tt = common_super_type(lkey.type, rkey.type)
+                node = Join(
+                    "mark_in", rel.node, sub.node,
+                    (_cast_ir(lkey, tt),), (_cast_ir(rkey, tt),), None,
+                )
+            else:  # EXISTS
+                q = node_ast.query
+                if isinstance(q.select, A.SetOp):
+                    raise PlanningError("EXISTS over a set operation not supported")
+                if q.select.group_by or self._collect_aggs(q.select, ()):
+                    raise PlanningError("EXISTS with aggregation not supported")
+                inner, correlated = self._split_correlated(q, outer_scope, ctes)
+                lkeys, rkeys, res_ir = self._correlation_parts(
+                    rel, inner, correlated, outer, outer_t=t
+                )
+                if not lkeys:
+                    raise PlanningError("EXISTS subquery without equality correlation")
+                node = Join(
+                    "mark", rel.node, inner.node,
+                    tuple(lkeys), tuple(rkeys), res_ir,
+                )
+            mark_ref = FieldRef(len(rel.fields), BOOLEAN)
+            rel = RelationPlan(node, rel.fields + [Field(None, None, BOOLEAN)])
+            sub_map[node_ast] = (
+                Call("not", (mark_ref,), BOOLEAN)
+                if getattr(node_ast, "negated", False)
+                else mark_ref
+            )
+        return rel, sub_map
 
     def _split_correlated(
         self, q: A.Query, outer_scope: Scope, ctes: dict[str, A.Query]
@@ -1188,7 +1308,14 @@ class Planner:
         local: list[A.Expr] = []
         correlated: list[A.Expr] = []
         if sel.where is not None:
-            for conj in _split_conjuncts(sel.where):
+            conjuncts: list[A.Expr] = []
+            for c in _split_conjuncts(sel.where):
+                # (corr-eq AND x) OR (corr-eq AND y) -> corr-eq AND (x OR y):
+                # hoisting the shared correlation out of OR branches is what
+                # makes TPC-DS q41's correlated count decorrelatable
+                # (reference: ExtractCommonPredicatesExpressionRewriter)
+                conjuncts.extend(_split_conjuncts(_extract_common_or_conjuncts(c)))
+            for conj in conjuncts:
                 if _is_local(conj, inner.scope):
                     local.append(conj)
                 else:
@@ -1246,19 +1373,23 @@ class Planner:
         )
         return RelationPlan(node, rel.fields)
 
-    def _semi_join(
+    def _correlation_parts(
         self,
         rel: RelationPlan,
         inner: RelationPlan,
         correlated: list[A.Expr],
-        negated: bool,
         outer: Optional[Scope],
-        extra_pairs: list[tuple[IrExpr, IrExpr]],
-    ) -> RelationPlan:
-        outer_t = _Translator(rel.scope, outer)
+        outer_t: Optional["_Translator"] = None,
+    ) -> tuple[list[IrExpr], list[IrExpr], Optional[IrExpr]]:
+        """Split correlated conjuncts into equi-join key pairs and a residual
+        over the concatenated (outer ++ inner) schema — the decorrelation
+        step shared by semi/anti joins and mark joins (reference:
+        TransformCorrelatedExistsToJoin's correlation extraction)."""
+        if outer_t is None:
+            outer_t = _Translator(rel.scope, outer)
         inner_t = _Translator(inner.scope, Scope(rel.fields, outer))
-        lkeys: list[IrExpr] = [p[0] for p in extra_pairs]
-        rkeys: list[IrExpr] = [p[1] for p in extra_pairs]
+        lkeys: list[IrExpr] = []
+        rkeys: list[IrExpr] = []
         residual_ast: list[A.Expr] = []
         for conj in correlated:
             pair = _correlated_equi_pair(conj, rel.scope, inner.scope)
@@ -1273,10 +1404,23 @@ class Planner:
                 residual_ast.append(conj)
         res_ir = None
         if residual_ast:
-            # residual over concatenated (outer ++ inner) schema
             concat_scope = Scope(rel.fields + inner.fields, outer)
             ct = _Translator(concat_scope, outer)
             res_ir = _conjoin([_as_bool(ct.translate(x)) for x in residual_ast])
+        return lkeys, rkeys, res_ir
+
+    def _semi_join(
+        self,
+        rel: RelationPlan,
+        inner: RelationPlan,
+        correlated: list[A.Expr],
+        negated: bool,
+        outer: Optional[Scope],
+        extra_pairs: list[tuple[IrExpr, IrExpr]],
+    ) -> RelationPlan:
+        lkeys, rkeys, res_ir = self._correlation_parts(rel, inner, correlated, outer)
+        lkeys = [p[0] for p in extra_pairs] + lkeys
+        rkeys = [p[1] for p in extra_pairs] + rkeys
         if not lkeys:
             raise PlanningError("EXISTS subquery without equality correlation")
         node = Join(
@@ -2152,6 +2296,47 @@ def _extract_common_or_conjuncts(e: A.Expr) -> A.Expr:
     for c in common:
         out = A.BinOp("and", c, out)
     return out
+
+
+def _substitute_aliases(e: A.Expr, items: Sequence[A.SelectItem]) -> A.Expr:
+    """Replace bare identifiers that name select-item aliases with the
+    aliased expression (ORDER BY expression scope includes output names)."""
+    import dataclasses as _dc
+
+    if isinstance(e, A.Ident) and len(e.parts) == 1:
+        for it in items:
+            if it.alias == e.parts[0]:
+                return it.expr
+        return e
+    if isinstance(e, (A.ScalarSubquery, A.Exists)):
+        return e  # alias scope does not reach into subqueries
+    if isinstance(e, A.CaseExpr):
+        whens = tuple(
+            (_substitute_aliases(c, items), _substitute_aliases(r, items))
+            for c, r in e.whens
+        )
+        default = (
+            None if e.default is None else _substitute_aliases(e.default, items)
+        )
+        return _dc.replace(e, whens=whens, default=default)
+    if not _dc.is_dataclass(e):
+        return e
+    changes = {}
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, A.Expr):
+            nv = _substitute_aliases(v, items)
+            if nv is not v:
+                changes[f.name] = nv
+        elif (
+            isinstance(v, tuple)
+            and v
+            and all(isinstance(x, A.Expr) for x in v)
+        ):
+            nv = tuple(_substitute_aliases(x, items) for x in v)
+            if nv != v:
+                changes[f.name] = nv
+    return _dc.replace(e, **changes) if changes else e
 
 
 def _ast_children(e: A.Expr) -> list[A.Expr]:

@@ -130,6 +130,8 @@ def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:
         right = estimate(node.right, catalogs)
         if node.kind in ("semi", "anti", "null_anti"):
             return PlanStats(max(1.0, 0.5 * left.rows), left.columns)
+        if node.kind in ("mark", "mark_in"):  # row-preserving: adds a column
+            return PlanStats(left.rows, left.columns)
         if node.kind == "cross":
             return PlanStats(left.rows, left.columns)
         ndv = None

@@ -67,6 +67,11 @@ class Engine:
         distributed: bool = False,
         devices=None,
     ):
+        from ..utils.compilecache import enable_persistent_cache
+
+        # warm compiles across processes: interactive latency depends on it
+        # (a cold q03 costs ~36s of XLA compile; a cached one, seconds)
+        enable_persistent_cache()
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
         self.planner = Planner(self.catalogs, default_catalog)
