@@ -1,0 +1,202 @@
+"""Row-pattern recognition: the MATCH_RECOGNIZE matcher.
+
+The reference implements this as an NFA-program interpreter over one row at a
+time (core/trino-main/src/main/java/io/trino/operator/window/matcher/
+Matcher.java + IrRowPatternToProgramRewriter).  Here the DEFINE predicates
+are evaluated VECTORIZED over the whole sorted page first (one device pass
+per label — masks, not per-row virtual calls), and only the pattern walk
+itself — inherently sequential under AFTER MATCH SKIP semantics — runs as a
+compact backtracking VM over those boolean masks on the host.
+
+Pattern compilation (Thompson construction with greedy/reluctant priority):
+
+    instructions:
+      ("row", label_idx)   consume one row that satisfies label's mask
+      ("split", a, b)      try a first, then b (priority = preferment order)
+      ("jmp", a)
+      ("match",)
+
+SQL preferment (greedy quantifiers prefer longer, alternation prefers the
+left branch) maps exactly to the split priority of a backtracking walk.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["compile_pattern", "find_matches"]
+
+_MAX_REPEAT_UNROLL = 64  # {n,m} unroll guard
+_STEP_BUDGET_FACTOR = 512  # backtracking step cap per start row (VM safety)
+
+
+def compile_pattern(pattern) -> tuple[tuple[tuple, ...], tuple[str, ...]]:
+    """Pattern AST (sql/ast.py Pat*) -> (program, labels)."""
+    from ..sql.ast import PatAlt, PatConcat, PatLabel, PatQuant
+
+    labels: list[str] = []
+    label_ix: dict[str, int] = {}
+    prog: list[tuple] = []
+
+    def lab(name: str) -> int:
+        if name not in label_ix:
+            label_ix[name] = len(labels)
+            labels.append(name)
+        return label_ix[name]
+
+    def emit(node) -> None:
+        if isinstance(node, PatLabel):
+            prog.append(("row", lab(node.label)))
+            return
+        if isinstance(node, PatConcat):
+            for p in node.parts:
+                emit(p)
+            return
+        if isinstance(node, PatAlt):
+            # chain of splits preferring the leftmost branch
+            jumps: list[int] = []
+            for i, p in enumerate(node.parts):
+                if i < len(node.parts) - 1:
+                    split_at = len(prog)
+                    prog.append(None)  # placeholder split
+                    emit(p)
+                    jumps.append(len(prog))
+                    prog.append(None)  # placeholder jmp to end
+                    prog[split_at] = ("split", split_at + 1, len(prog))
+                else:
+                    emit(p)
+            end = len(prog)
+            for j in jumps:
+                prog[j] = ("jmp", end)
+            return
+        if isinstance(node, PatQuant):
+            lo = node.lo
+            hi = node.hi
+            if hi is not None and hi - lo > _MAX_REPEAT_UNROLL:
+                raise ValueError(f"pattern repetition too large: {{{lo},{hi}}}")
+            for _ in range(lo):
+                emit(node.child)
+            if hi is None:
+                # (child)* loop: split(body, exit) for greedy,
+                # split(exit, body) for reluctant
+                loop_at = len(prog)
+                prog.append(None)
+                emit(node.child)
+                prog.append(("jmp", loop_at))
+                exit_at = len(prog)
+                prog[loop_at] = (
+                    ("split", loop_at + 1, exit_at)
+                    if node.greedy
+                    else ("split", exit_at, loop_at + 1)
+                )
+            else:
+                # (child){0, hi-lo}: nested optional copies
+                exits: list[int] = []
+                for _ in range(hi - lo):
+                    split_at = len(prog)
+                    prog.append(None)
+                    exits.append(split_at)
+                    emit(node.child)
+                end = len(prog)
+                for split_at in exits:
+                    prog[split_at] = (
+                        ("split", split_at + 1, end)
+                        if node.greedy
+                        else ("split", end, split_at + 1)
+                    )
+            return
+        raise TypeError(f"unknown pattern node {node!r}")
+
+    emit(pattern)
+    prog.append(("match",))
+    return tuple(prog), tuple(labels)
+
+
+def _run_vm(
+    program: Sequence[tuple],
+    masks: np.ndarray,  # [L, n] bool — label eligibility per sorted row
+    start: int,
+    end: int,
+) -> Optional[list[tuple[int, int]]]:
+    """Find the PREFERRED match starting exactly at `start`, as a list of
+    (row, label_idx) assignments (possibly spanning to < end).  Returns None
+    when no non-empty match starts here.  Iterative backtracking: the trail
+    of split decisions is the stack; priority order of `split` encodes SQL
+    preferment."""
+    # stack entries: (pc, pos, n_assigned, alt_pc) — alt_pc is the branch to
+    # take when backtracking into this entry
+    assigned: list[tuple[int, int]] = []
+    stack: list[tuple[int, int, int]] = []  # (alt_pc, pos, n_assigned)
+    pc, pos = 0, start
+    budget = _STEP_BUDGET_FACTOR * max(end - start, 1)
+    while True:
+        budget -= 1
+        if budget <= 0:
+            raise RuntimeError(
+                "row pattern exceeded step budget (catastrophic backtracking"
+                " or empty-loop pattern)"
+            )
+        op = program[pc]
+        kind = op[0]
+        if kind == "row":
+            if pos < end and masks[op[1], pos]:
+                assigned.append((pos, op[1]))
+                pos += 1
+                pc += 1
+                continue
+        elif kind == "jmp":
+            pc = op[1]
+            continue
+        elif kind == "split":
+            stack.append((op[2], pos, len(assigned)))
+            pc = op[1]
+            continue
+        else:  # match
+            if assigned:
+                return assigned
+            # empty match: treat as failure (v1 skips empty matches rather
+            # than emitting empty-match rows)
+        # backtrack
+        if not stack:
+            return None
+        pc, pos, keep = stack.pop()
+        del assigned[keep:]
+
+
+def find_matches(
+    program: Sequence[tuple],
+    masks: np.ndarray,  # [L, n] bool over SORTED rows
+    part_start: np.ndarray,  # [n] int — partition start index per row
+    after_skip: str,
+) -> list[tuple[int, list[tuple[int, int]]]]:
+    """Walk every partition; returns the match list
+    [(match_number, [(sorted_row, label_idx), ...]), ...] in output order.
+    match_number is 1-based and counts per partition (SQL MATCH_NUMBER()).
+    With AFTER MATCH SKIP TO NEXT ROW matches may overlap, so rows can
+    appear in several matches — a list, not a per-row array."""
+    n = masks.shape[1] if masks.ndim == 2 else 0
+    out: list[tuple[int, list[tuple[int, int]]]] = []
+    i = 0
+    while i < n:
+        p0 = part_start[i]
+        p_end = i
+        while p_end < n and part_start[p_end] == p0:
+            p_end += 1
+        start = i
+        mno = 0
+        while start < p_end:
+            found = _run_vm(program, masks, start, p_end)
+            if found is None:
+                start += 1
+                continue
+            mno += 1
+            out.append((mno, found))
+            last_row = found[-1][0]
+            if after_skip == "next_row":
+                start = start + 1
+            else:  # past_last
+                start = last_row + 1
+        i = p_end
+    return out

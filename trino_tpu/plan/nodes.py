@@ -22,7 +22,7 @@ from .ir import IrExpr
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
     "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
-    "Exchange", "Unnest", "EnforceSingleRow",
+    "Exchange", "Unnest", "EnforceSingleRow", "MatchRecognize",
 ]
 
 
@@ -364,6 +364,62 @@ class Window(PlanNode):
     @property
     def output_types(self):
         return self.child.output_types + tuple(c.type for c in self.calls)
+
+
+@dataclass(frozen=True)
+class MatchRecognize(PlanNode):
+    """Row-pattern recognition (reference: PatternRecognitionNode +
+    operator/window/matcher/Matcher.java).  The pattern is pre-compiled at
+    plan time into the backtracking VM program (ops/matchrec.py) so the node
+    is plain serializable data.
+
+    prev_exprs: (expr over child schema, shift k) pairs; the executor
+    appends each as a partition-aware shifted column, and `defines` IR
+    references them as FieldRef(C + j) where C = len(child columns).
+
+    prims: per-measure primitive sources (kind, label or None, child field
+    index or -1, type) with kind in first|last|classifier|match_number;
+    measure IR references prims positionally (FieldRef over the prim scope).
+
+    Output schema: ONE ROW PER MATCH -> partition key columns ++ measures;
+    ALL ROWS PER MATCH -> child columns ++ measures.
+    """
+
+    child: PlanNode
+    partition_keys: tuple[IrExpr, ...]
+    order_keys: tuple["SortKey", ...]
+    labels: tuple[str, ...]
+    program: tuple[tuple, ...]
+    defines: tuple[IrExpr, ...]  # one boolean IR per label, label order
+    prev_exprs: tuple[tuple[IrExpr, int], ...]
+    prims: tuple[tuple, ...]  # (kind, label_idx|-1, field_idx|-1)
+    prim_types: tuple[Type, ...]
+    measures: tuple[IrExpr, ...]  # over the prim scope
+    measure_names: tuple[str, ...]
+    all_rows: bool
+    after_skip: str
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        if self.all_rows:
+            return self.child.output_names + self.measure_names
+        part = tuple(
+            self.child.output_names[k.index] if hasattr(k, "index") else f"_p{i}"
+            for i, k in enumerate(self.partition_keys)
+        )
+        return part + self.measure_names
+
+    @property
+    def output_types(self):
+        if self.all_rows:
+            return self.child.output_types + tuple(m.type for m in self.measures)
+        return tuple(k.type for k in self.partition_keys) + tuple(
+            m.type for m in self.measures
+        )
 
 
 @dataclass(frozen=True)

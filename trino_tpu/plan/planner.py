@@ -670,7 +670,231 @@ class Planner:
                 t = _Translator(rel.scope, outer)
                 rel = RelationPlan(Filter(rel.node, _as_bool(t.translate(c))), rel.fields)
             return rel
+        if isinstance(r, A.MatchRecognizeRelation):
+            return self._plan_match_recognize(r, outer, ctes)
         raise PlanningError(f"unsupported relation: {r}")
+
+    def _plan_match_recognize(
+        self,
+        r: A.MatchRecognizeRelation,
+        outer: Optional[Scope],
+        ctes: dict[str, A.Query],
+    ) -> RelationPlan:
+        """MATCH_RECOGNIZE -> MatchRecognize node (reference:
+        sql/analyzer/PatternRecognitionAnalyzer.java + RelationPlanner's
+        pattern recognition planning).  DEFINE conditions are rewritten over
+        the child schema: `L.col` (L = the defining label) and bare `col`
+        reference the CURRENT row, PREV(expr[, k]) becomes a partition-aware
+        shifted column.  Measures support FIRST/LAST(L.col | col), `L.col`
+        (= LAST), bare columns (= LAST row of the match), CLASSIFIER(),
+        MATCH_NUMBER(), and arbitrary scalar expressions over those."""
+        from ..ops.matchrec import compile_pattern
+        from .nodes import MatchRecognize
+
+        child = self._plan_relation(r.input, outer, ctes)
+        t = _Translator(child.scope, outer)
+        part_irs = [t.translate(e) for e in r.partition_by]
+        order_keys = tuple(
+            SortKey(t.translate(si.expr), si.ascending, _nulls_first(si))
+            for si in r.order_by
+        )
+        program, labels = compile_pattern(r.pattern)
+        def_map = {lab.lower(): cond for lab, cond in r.defines}
+        unknown = set(def_map) - set(labels)
+        if unknown:
+            raise PlanningError(f"DEFINE for labels not in pattern: {unknown}")
+
+        C = len(child.fields)
+        prev_exprs: list[tuple[IrExpr, int]] = []
+
+        def strip_label(e: A.Expr, label: str) -> A.Expr:
+            """L.col -> col for the defining label; other labels rejected."""
+            if isinstance(e, A.Ident) and len(e.parts) == 2:
+                qual = e.parts[0].lower()
+                if qual == label:
+                    return A.Ident((e.parts[1],))
+                if qual in labels:
+                    raise PlanningError(
+                        f"DEFINE {label}: reference to other label"
+                        f" {e.parts[0]} not supported"
+                    )
+            if isinstance(e, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+                raise PlanningError("subqueries not allowed in DEFINE")
+            import dataclasses as _dc
+
+            if not _dc.is_dataclass(e):
+                return e
+            changes = {}
+            for f in _dc.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, A.Expr):
+                    nv = strip_label(v, label)
+                    if nv is not v:
+                        changes[f.name] = nv
+                elif isinstance(v, tuple) and v and all(
+                    isinstance(x, A.Expr) for x in v
+                ):
+                    nv = tuple(strip_label(x, label) for x in v)
+                    if nv != v:
+                        changes[f.name] = nv
+            return _dc.replace(e, **changes) if changes else e
+
+        def lower_prev(ir: IrExpr) -> IrExpr:
+            """Call('prev', (expr[, k])) subtrees -> FieldRef(C + j)."""
+            if isinstance(ir, Call) and ir.name == "prev":
+                inner = ir.args[0]
+                k = 1
+                if len(ir.args) > 1:
+                    if not isinstance(ir.args[1], Const):
+                        raise PlanningError("PREV offset must be a literal")
+                    k = int(ir.args[1].value)
+                inner = lower_prev(inner)
+                prev_exprs.append((inner, k))
+                return FieldRef(C + len(prev_exprs) - 1, inner.type)
+            import dataclasses as _dc
+
+            changes = {}
+            for f in _dc.fields(ir):
+                v = getattr(ir, f.name)
+                if isinstance(v, IrExpr):
+                    nv = lower_prev(v)
+                    if nv is not v:
+                        changes[f.name] = nv
+                elif isinstance(v, tuple) and v and all(
+                    isinstance(x, IrExpr) for x in v
+                ):
+                    nv = tuple(lower_prev(x) for x in v)
+                    if nv != v:
+                        changes[f.name] = nv
+            return _dc.replace(ir, **changes) if changes else ir
+
+        define_irs: list[IrExpr] = []
+        for lab in labels:
+            cond = def_map.get(lab)
+            if cond is None:
+                define_irs.append(Const(True, BOOLEAN))  # undefined: always ok
+                continue
+            stripped = strip_label(cond, lab)
+            ir = t.translate(stripped)
+            define_irs.append(_as_bool(lower_prev(ir)))
+
+        # ---- measures: rewrite primitives into a prim scope ---------------
+        prims: list[tuple] = []
+        prim_types: list[Type] = []
+
+        def prim_ref(kind: str, label_ix: int, field_ix: int, tt: Type) -> FieldRef:
+            key = (kind, label_ix, field_ix)
+            for i, p in enumerate(prims):
+                if p == key:
+                    return FieldRef(i, prim_types[i])
+            prims.append(key)
+            prim_types.append(tt)
+            return FieldRef(len(prims) - 1, tt)
+
+        def child_field(name: str) -> tuple[int, Type]:
+            hit = child.scope.try_resolve((name,))
+            if hit is None or hit[0] != 0:
+                raise PlanningError(f"MEASURES: column not found: {name}")
+            return hit[1], hit[2]
+
+        def prim_placeholder(kind: str, label_ix: int, field_ix: int, tt: Type):
+            ref = prim_ref(kind, label_ix, field_ix, tt)
+            return A.Ident((f"$m{ref.index}",))
+
+        def rewrite_measure(e: A.Expr) -> A.Expr:
+            """Replace pattern primitives with $m<j> placeholder idents so
+            arbitrary scalar expressions over them translate normally."""
+            if isinstance(e, A.FuncCall):
+                fn = e.name.lower()
+                if fn == "match_number" and not e.args:
+                    return prim_placeholder("match_number", -1, -1, BIGINT)
+                if fn == "classifier" and not e.args:
+                    return prim_placeholder("classifier", -1, -1, VARCHAR)
+                if fn in ("first", "last") and len(e.args) == 1 and isinstance(
+                    e.args[0], A.Ident
+                ):
+                    parts = e.args[0].parts
+                    if len(parts) == 2 and parts[0].lower() in labels:
+                        ix, tt = child_field(parts[1])
+                        return prim_placeholder(
+                            fn, labels.index(parts[0].lower()), ix, tt
+                        )
+                    if len(parts) == 1:
+                        ix, tt = child_field(parts[0])
+                        return prim_placeholder(fn, -1, ix, tt)
+            if isinstance(e, A.Ident):
+                if len(e.parts) == 2 and e.parts[0].lower() in labels:
+                    ix, tt = child_field(e.parts[1])
+                    return prim_placeholder(
+                        "last", labels.index(e.parts[0].lower()), ix, tt
+                    )
+                if len(e.parts) == 1:
+                    ix, tt = child_field(e.parts[0])
+                    return prim_placeholder("last", -1, ix, tt)
+            if isinstance(e, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+                raise PlanningError("subqueries not allowed in MEASURES")
+            import dataclasses as _dc
+
+            if not _dc.is_dataclass(e):
+                return e
+            changes = {}
+            for f in _dc.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, A.Expr):
+                    nv = rewrite_measure(v)
+                    if nv is not v:
+                        changes[f.name] = nv
+                elif isinstance(v, tuple) and v and all(
+                    isinstance(x, A.Expr) for x in v
+                ):
+                    nv = tuple(rewrite_measure(x) for x in v)
+                    if nv != v:
+                        changes[f.name] = nv
+            return _dc.replace(e, **changes) if changes else e
+
+        rewritten = [(rewrite_measure(e), name) for e, name in r.measures]
+        prim_scope = Scope(
+            [Field(None, f"$m{i}", tt) for i, tt in enumerate(prim_types)]
+        )
+        mt = _Translator(prim_scope, None)
+        measure_irs: list[IrExpr] = []
+        measure_names: list[str] = []
+        for e, name in rewritten:
+            measure_irs.append(mt.translate(e))
+            measure_names.append(name)
+
+        # ONE ROW PER MATCH partition key columns must be plain FieldRefs so
+        # output naming works
+        if not r.all_rows:
+            for ir in part_irs:
+                if not isinstance(ir, FieldRef):
+                    raise PlanningError(
+                        "PARTITION BY expressions must be plain columns"
+                    )
+
+        node = MatchRecognize(
+            child.node, tuple(part_irs), order_keys, labels, program,
+            tuple(define_irs), tuple(prev_exprs), tuple(prims),
+            tuple(prim_types), tuple(measure_irs), tuple(measure_names),
+            r.all_rows, r.after_skip,
+        )
+        alias = r.alias
+        if r.all_rows:
+            fields = [
+                Field(alias, f.name, f.type) for f in child.fields
+            ] + [
+                Field(alias, n, m.type)
+                for n, m in zip(measure_names, measure_irs)
+            ]
+        else:
+            fields = [
+                Field(alias, child.fields[ir.index].name, ir.type)
+                for ir in part_irs
+            ] + [
+                Field(alias, n, m.type)
+                for n, m in zip(measure_names, measure_irs)
+            ]
+        return RelationPlan(node, fields)
 
     def _swap_right_join(self, left, right, on, outer):
         rel = self._make_join("left", right, left, [], outer, extra_on=on)

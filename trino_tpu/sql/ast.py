@@ -247,6 +247,57 @@ class JoinRelation(Relation):
     on: Optional[Expr] = None
 
 
+# ---------------------------------------------------- row pattern recognition
+
+
+class Pattern:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class PatLabel(Pattern):
+    label: str
+
+
+@dataclass(frozen=True)
+class PatConcat(Pattern):
+    parts: tuple["Pattern", ...]
+
+
+@dataclass(frozen=True)
+class PatAlt(Pattern):
+    parts: tuple["Pattern", ...]
+
+
+@dataclass(frozen=True)
+class PatQuant(Pattern):
+    """child{lo,hi}; hi=None means unbounded; greedy=False for reluctant
+    (`?` suffix on the quantifier)."""
+
+    child: "Pattern"
+    lo: int
+    hi: Optional[int]
+    greedy: bool = True
+
+
+@dataclass(frozen=True)
+class MatchRecognizeRelation(Relation):
+    """FROM input MATCH_RECOGNIZE (PARTITION BY ... ORDER BY ... MEASURES ...
+    [ONE|ALL] ROW[S] PER MATCH [AFTER MATCH SKIP ...] PATTERN (...) DEFINE ...)
+    (reference: sql/tree/PatternRecognitionRelation + grammar
+    patternRecognition in SqlBase.g4)."""
+
+    input: Relation
+    partition_by: tuple[Expr, ...]
+    order_by: tuple["SortItem", ...]
+    measures: tuple[tuple[Expr, str], ...]  # (expr, alias)
+    all_rows: bool  # ALL ROWS PER MATCH (vs ONE ROW PER MATCH)
+    after_skip: str  # 'past_last' | 'next_row'
+    pattern: Pattern
+    defines: tuple[tuple[str, Expr], ...]  # (label, condition)
+    alias: Optional[str] = None
+
+
 # --------------------------------------------------------------------- query
 
 

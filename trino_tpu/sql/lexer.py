@@ -32,7 +32,7 @@ class Token:
 _OPERATORS = [
     "<>", "!=", ">=", "<=", "||", "->", "=>",
     "+", "-", "*", "/", "%", "(", ")", ",", ".", ";", "<", ">", "=", "?",
-    "[", "]",
+    "[", "]", "{", "}", "|", "$", "^",
 ]
 
 

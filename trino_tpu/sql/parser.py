@@ -29,7 +29,7 @@ _RESERVED_STOP = {
     "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "ON", "JOIN",
     "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "UNION", "EXCEPT", "INTERSECT",
     "AND", "OR", "NOT", "AS", "BY", "ASC", "DESC", "THEN", "ELSE", "WHEN",
-    "END", "SELECT", "WITH", "USING", "NULLS",
+    "END", "SELECT", "WITH", "USING", "NULLS", "MATCH_RECOGNIZE",
 }
 
 
@@ -336,6 +336,136 @@ class _Parser:
             rel = JoinRelation(kind, rel, right, on)
 
     def parse_relation_primary(self) -> Relation:
+        rel = self._parse_relation_base()
+        if self.peek_kw("MATCH_RECOGNIZE"):
+            rel = self._parse_match_recognize(rel)
+        return rel
+
+    def _parse_match_recognize(self, rel: Relation) -> Relation:
+        """MATCH_RECOGNIZE ( [PARTITION BY ...] [ORDER BY ...]
+        [MEASURES e AS n, ...] [ONE ROW PER MATCH | ALL ROWS PER MATCH]
+        [AFTER MATCH SKIP (PAST LAST ROW | TO NEXT ROW)]
+        PATTERN ( ... ) DEFINE l AS cond, ... ) [AS alias]
+        (reference grammar: SqlBase.g4 patternRecognition)."""
+        from .ast import MatchRecognizeRelation
+
+        self.expect_kw("MATCH_RECOGNIZE")
+        self.expect_op("(")
+        partition_by: list[Expr] = []
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        order_by: list = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self._parse_sort_items()
+        measures: list[tuple[Expr, str]] = []
+        if self.accept_kw("MEASURES"):
+            while True:
+                e = self.parse_expr()
+                self.expect_kw("AS")
+                measures.append((e, self.ident()))
+                if not self.accept_op(","):
+                    break
+        all_rows = False
+        if self.accept_kw("ONE"):
+            self.expect_kw("ROW")
+            self.expect_kw("PER")
+            self.expect_kw("MATCH")
+        elif self.accept_kw("ALL"):
+            self.expect_kw("ROWS")
+            self.expect_kw("PER")
+            self.expect_kw("MATCH")
+            all_rows = True
+        after_skip = "past_last"
+        if self.accept_kw("AFTER"):
+            self.expect_kw("MATCH")
+            self.expect_kw("SKIP")
+            if self.accept_kw("PAST"):
+                self.expect_kw("LAST")
+                self.expect_kw("ROW")
+            elif self.accept_kw("TO"):
+                self.expect_kw("NEXT")
+                self.expect_kw("ROW")
+                after_skip = "next_row"
+            else:
+                raise SqlSyntaxError(
+                    "AFTER MATCH SKIP: only PAST LAST ROW / TO NEXT ROW"
+                )
+        self.expect_kw("PATTERN")
+        self.expect_op("(")
+        pattern = self._parse_pattern_alt()
+        self.expect_op(")")
+        self.expect_kw("DEFINE")
+        defines: list[tuple[str, Expr]] = []
+        while True:
+            label = self.ident().lower()
+            self.expect_kw("AS")
+            defines.append((label, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        alias = self._optional_alias()
+        return MatchRecognizeRelation(
+            rel, tuple(partition_by), tuple(order_by), tuple(measures),
+            all_rows, after_skip, pattern, tuple(defines), alias,
+        )
+
+    def _parse_pattern_alt(self):
+        from .ast import PatAlt
+
+        parts = [self._parse_pattern_concat()]
+        while self.accept_op("|"):
+            parts.append(self._parse_pattern_concat())
+        return parts[0] if len(parts) == 1 else PatAlt(tuple(parts))
+
+    def _parse_pattern_concat(self):
+        from .ast import PatConcat
+
+        parts = []
+        while self.cur.kind in ("IDENT", "QIDENT") or self.peek_op("("):
+            parts.append(self._parse_pattern_quant())
+        if not parts:
+            raise SqlSyntaxError(f"empty row pattern at {self.cur.pos}")
+        return parts[0] if len(parts) == 1 else PatConcat(tuple(parts))
+
+    def _parse_pattern_quant(self):
+        from .ast import PatLabel, PatQuant
+
+        if self.accept_op("("):
+            prim = self._parse_pattern_alt()
+            self.expect_op(")")
+        else:
+            prim = PatLabel(self.ident().lower())
+        lo, hi, quant = None, None, False
+        if self.accept_op("*"):
+            quant, lo, hi = True, 0, None
+        elif self.accept_op("+"):
+            quant, lo, hi = True, 1, None
+        elif self.accept_op("?"):
+            quant, lo, hi = True, 0, 1
+        elif self.accept_op("{"):
+            quant = True
+            lo = 0
+            if self.cur.kind == "NUMBER":
+                lo = int(self.cur.value)
+                self.i += 1
+            if self.accept_op(","):
+                hi = None
+                if self.cur.kind == "NUMBER":
+                    hi = int(self.cur.value)
+                    self.i += 1
+            else:
+                hi = lo
+            self.expect_op("}")
+        if not quant:
+            return prim
+        greedy = not self.accept_op("?")
+        return PatQuant(prim, lo, hi, greedy)
+
+    def _parse_relation_base(self) -> Relation:
         if self.peek_kw("UNNEST"):
             from .ast import UnnestRelation
 
@@ -423,6 +553,27 @@ class _Parser:
         # catalogs; the reference resolves via MetadataManager)
         catalog = parts[0] if len(parts) > 1 else None
         return Table(parts[-1], alias, catalog)
+
+    def _parse_sort_items(self) -> list[SortItem]:
+        """Comma list of `expr [ASC|DESC] [NULLS FIRST|LAST]` (the caller has
+        already consumed ORDER BY)."""
+        out: list[SortItem] = []
+        while True:
+            e = self.parse_expr()
+            asc = True
+            if self.accept_kw("DESC"):
+                asc = False
+            else:
+                self.accept_kw("ASC")
+            nulls_first = None
+            if self.accept_kw("NULLS"):
+                nulls_first = bool(self.accept_kw("FIRST"))
+                if not nulls_first:
+                    self.expect_kw("LAST")
+            out.append(SortItem(e, asc, nulls_first))
+            if not self.accept_op(","):
+                break
+        return out
 
     def _optional_alias(self) -> Optional[str]:
         if self.accept_kw("AS"):
