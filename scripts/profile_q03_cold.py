@@ -1,0 +1,51 @@
+"""Where does q03's cold warm-up time go?  Counts whole-plan compiles
+(capacity retries), eager-sizing passes, and phases."""
+import os, sys, time
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+from trino_tpu.utils.compilecache import enable_persistent_cache
+enable_persistent_cache(_REPO)
+import jax
+print("backend:", jax.default_backend(), flush=True)
+
+from tests.tpch_queries import QUERIES
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.runtime.engine import Engine
+from trino_tpu.exec import compiler as C
+
+orig_run = C.LocalExecutor._run
+orig_trace = C._trace_plan
+events = []
+def timed_run(self, plan, inputs, caps):
+    t0 = time.perf_counter()
+    out = orig_run(self, plan, inputs, caps)
+    dt = time.perf_counter() - t0
+    events.append(("jit_run", dt, dict(caps)))
+    print(f"  [jit_run] {dt:.2f}s caps={caps}", flush=True)
+    return out
+def timed_trace(plan, inputs, caps, **kw):
+    t0 = time.perf_counter()
+    out = orig_trace(plan, inputs, caps, **kw)
+    dt = time.perf_counter() - t0
+    events.append(("eager_trace", dt, dict(caps)))
+    print(f"  [eager_trace] {dt:.2f}s caps={caps}", flush=True)
+    return out
+C.LocalExecutor._run = timed_run
+C._trace_plan = timed_trace
+
+qname = os.environ.get("Q", "q03")
+sf = float(os.environ.get("SF", "1"))
+eng = Engine()
+eng.register_catalog("tpch", TpchConnector(sf))
+t0 = time.perf_counter()
+plan = eng.plan(QUERIES[qname])
+t_plan = time.perf_counter() - t0
+print(f"plan: {t_plan:.2f}s", flush=True)
+t0 = time.perf_counter()
+eng.executor.execute(plan)
+print(f"first execute: {time.perf_counter()-t0:.2f}s", flush=True)
+for kind, dt, caps in events:
+    print(f"  {kind}: {dt:.2f}s caps={caps}", flush=True)
+t0 = time.perf_counter()
+eng.executor.execute(plan)
+print(f"second execute: {time.perf_counter()-t0:.2f}s", flush=True)

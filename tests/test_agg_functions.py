@@ -176,3 +176,90 @@ def test_approx_distinct_distributed_matches_local():
     for gv, ad in got_local:
         true = len(np.unique(x[g == gv]))
         assert abs(ad - true) / true < 0.05, (gv, ad, true)
+
+
+# ---------------------------------------------------------------- ordered
+# array_agg(x ORDER BY y) / listagg WITHIN GROUP (reference: ordered
+# aggregation inputs, docs/src/main/sphinx/functions/aggregate.md:20;
+# sqlite has no ordered array_agg, so these are expected-value tests)
+
+
+@pytest.fixture(scope="module")
+def ordered_engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="memory")
+    eng.register_catalog("memory", MemoryConnector())
+    eng.execute("create table oa (g bigint, x bigint, s varchar, y bigint)")
+    eng.execute(
+        "insert into oa values (1,10,'a',3),(1,20,'b',1),(1,30,'c',2),"
+        "(2,5,'d',2),(2,6,'e',1),(1,40,'f',null)"
+    )
+    return eng
+
+
+def test_array_agg_order_by(ordered_engine):
+    rows = ordered_engine.query(
+        "select g, array_agg(x order by y) from oa group by g order by g"
+    )
+    # nulls last by default: y=NULL row (x=40) collects last
+    assert rows == [(1, [20, 30, 10, 40]), (2, [6, 5])]
+
+
+def test_array_agg_order_by_desc(ordered_engine):
+    rows = ordered_engine.query(
+        "select g, array_agg(x order by y desc) from oa group by g order by g"
+    )
+    # Trino default null ordering: NULLS FIRST under DESC
+    assert rows == [(1, [40, 10, 30, 20]), (2, [5, 6])]
+
+
+def test_array_agg_order_by_nulls_first(ordered_engine):
+    rows = ordered_engine.query(
+        "select g, array_agg(x order by y nulls first) from oa group by g order by g"
+    )
+    assert rows == [(1, [40, 20, 30, 10]), (2, [6, 5])]
+
+
+def test_listagg_within_group(ordered_engine):
+    rows = ordered_engine.query(
+        "select g, listagg(s, '-') within group (order by y) "
+        "from oa group by g order by g"
+    )
+    assert rows == [(1, "b-c-a-f"), (2, "e-d")]
+
+
+def test_array_agg_order_by_global(ordered_engine):
+    rows = ordered_engine.query("select array_agg(s order by x desc) from oa")
+    assert rows == [(["f", "c", "b", "a", "e", "d"],)]
+
+
+def test_array_agg_order_by_second_key(ordered_engine):
+    rows = ordered_engine.query(
+        "select array_agg(s order by g desc, y) from oa"
+    )
+    # g=2 first (y asc: e,d), then g=1 (y asc: b,c,a, null-y f last)
+    assert rows == [(["e", "d", "b", "c", "a", "f"],)]
+
+
+def test_order_by_rejected_for_plain_aggs(ordered_engine):
+    import pytest as _pytest
+
+    from trino_tpu.plan.planner import PlanningError
+
+    with _pytest.raises(PlanningError):
+        ordered_engine.query("select sum(x order by y) from oa")
+
+
+def test_ordered_agg_rejected_with_over(ordered_engine):
+    """array_agg(x ORDER BY y) OVER (...) must error, not silently drop
+    the ordering (parse_over rebuilds the call)."""
+    import pytest as _pytest
+
+    from trino_tpu.sql.lexer import SqlSyntaxError
+
+    with _pytest.raises(SqlSyntaxError):
+        ordered_engine.query(
+            "select array_agg(x order by y) over (partition by g) from oa"
+        )

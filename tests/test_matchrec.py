@@ -1,0 +1,323 @@
+"""MATCH_RECOGNIZE row-pattern recognition tests.
+
+Expected results are hand-derived per the SQL:2016 semantics the reference
+implements (core/trino-main/.../operator/window/matcher/Matcher.java:28 and
+sql/analyzer/PatternRecognitionAnalyzer.java): greedy/reluctant quantifier
+preferment, leftmost-alternative preference, AFTER MATCH SKIP modes,
+FINAL measure semantics under ONE ROW PER MATCH and RUNNING semantics under
+ALL ROWS PER MATCH (Trino's defaults).  sqlite has no MATCH_RECOGNIZE, so
+these are expected-value tests rather than oracle diffs.
+"""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def mr_engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="memory")
+    eng.register_catalog("memory", MemoryConnector())
+    eng.execute("create table ticks (sym varchar, ts bigint, price double)")
+    eng.execute(
+        "insert into ticks values "
+        "('a',1,10.0),('a',2,8.0),('a',3,7.0),('a',4,9.0),('a',5,12.0),"
+        "('b',1,5.0),('b',2,6.0),('b',3,4.0),('b',4,3.0),('b',5,7.0)"
+    )
+    eng.execute("create table seq (ts bigint, x bigint)")
+    eng.execute("insert into seq values (1,1),(2,2),(3,3),(4,4),(5,5)")
+    return eng
+
+
+def test_v_shape_one_row_per_match(mr_engine):
+    """The canonical down+ up+ V-pattern, FINAL measures."""
+    rows = mr_engine.query("""
+      select * from ticks match_recognize (
+        partition by sym order by ts
+        measures match_number() as mno, classifier() as cls,
+                 first(down.ts) as start_ts, last(up.ts) as end_ts
+        one row per match
+        after match skip past last row
+        pattern (down+ up+)
+        define down as price < prev(price), up as price > prev(price)
+      )
+    """)
+    assert rows == [("a", 1, "UP", 2, 5), ("b", 1, "UP", 3, 5)]
+
+
+def test_all_rows_per_match_running_classifier(mr_engine):
+    """ALL ROWS PER MATCH: one output row per matched row, RUNNING
+    CLASSIFIER() = the current row's label."""
+    rows = mr_engine.query("""
+      select sym, ts, cls, mno from ticks match_recognize (
+        partition by sym order by ts
+        measures classifier() as cls, match_number() as mno
+        all rows per match
+        pattern (down+ up+)
+        define down as price < prev(price), up as price > prev(price)
+      ) where sym = 'b'
+    """)
+    assert rows == [
+        ("b", 3, "DOWN", 1),
+        ("b", 4, "DOWN", 1),
+        ("b", 5, "UP", 1),
+    ]
+
+
+def test_greedy_plus_takes_longest(mr_engine):
+    rows = mr_engine.query("""
+      select * from seq match_recognize (
+        order by ts
+        measures last(b.ts) as b_at
+        one row per match
+        pattern (a+ b)
+        define b as x >= 3
+      )
+    """)
+    # greedy a+ consumes up to ts4 so b lands on the LAST row satisfying it
+    assert rows == [(5,)]
+
+
+def test_reluctant_plus_takes_shortest(mr_engine):
+    rows = mr_engine.query("""
+      select * from seq match_recognize (
+        order by ts
+        measures last(b.ts) as b_at
+        one row per match
+        pattern (a+? b)
+        define b as x >= 3
+      )
+    """)
+    # reluctant a+? consumes the minimum: a={1}, b tries ts2 (x=2 fails),
+    # extends to a={1,2}, b=ts3 succeeds; a second match then starts at ts4
+    # (a={4}, b=ts5)
+    assert rows == [(3,), (5,)]
+
+
+def test_bounded_repetition(mr_engine):
+    rows = mr_engine.query("""
+      select * from seq match_recognize (
+        order by ts
+        measures first(a.ts) as f, last(a.ts) as l
+        one row per match
+        after match skip past last row
+        pattern (a{2,3})
+        define a as x < 10
+      )
+    """)
+    # greedy {2,3}: first match takes 3 rows, remainder takes 2
+    assert rows == [(1, 3), (4, 5)]
+
+
+def test_alternation_prefers_left(mr_engine):
+    mr_engine.execute("create table alt_t (ts bigint, x bigint)")
+    mr_engine.execute("insert into alt_t values (1,5),(2,20)")
+    rows = mr_engine.query("""
+      select * from alt_t match_recognize (
+        order by ts
+        measures first(a.ts) as ats, classifier() as cls
+        one row per match
+        pattern (a | b)
+        define a as x > 10, b as x > 0
+      )
+    """)
+    # row1: a fails -> b; row2: both match, left alternative (a) preferred
+    assert rows == [(None, "B"), (2, "A")]
+
+
+def test_after_match_skip_modes(mr_engine):
+    past = mr_engine.query("""
+      select * from seq match_recognize (
+        order by ts
+        measures first(a.ts) as f, last(a.ts) as l
+        one row per match
+        after match skip past last row
+        pattern (a a)
+        define a as x <= 4
+      )
+    """)
+    assert past == [(1, 2), (3, 4)]
+    nxt = mr_engine.query("""
+      select * from seq match_recognize (
+        order by ts
+        measures first(a.ts) as f, last(a.ts) as l
+        one row per match
+        after match skip to next row
+        pattern (a a)
+        define a as x <= 4
+      )
+    """)
+    # overlapping matches allowed
+    assert nxt == [(1, 2), (2, 3), (3, 4)]
+
+
+def test_prev_with_offset(mr_engine):
+    mr_engine.execute("create table po (ts bigint, price double)")
+    mr_engine.execute("insert into po values (1,1.0),(2,2.0),(3,5.0),(4,1.0)")
+    rows = mr_engine.query("""
+      select * from po match_recognize (
+        order by ts
+        measures first(a.ts) as at
+        one row per match
+        pattern (a)
+        define a as price > prev(price, 2)
+      )
+    """)
+    # only ts3 has prev(price,2)=1.0 with 5.0 > 1.0; ts4: 1.0 > 2.0 false
+    assert rows == [(3,)]
+
+
+def test_next_navigation(mr_engine):
+    mr_engine.execute("create table nx (ts bigint, price double)")
+    mr_engine.execute("insert into nx values (1,3.0),(2,5.0),(3,2.0),(4,4.0)")
+    rows = mr_engine.query("""
+      select * from nx match_recognize (
+        order by ts
+        measures first(a.ts) as at
+        one row per match
+        after match skip past last row
+        pattern (a)
+        define a as price < next(price)
+      )
+    """)
+    # ts1 (3<5) and ts3 (2<4); ts4's NEXT is out of partition -> NULL -> false
+    assert rows == [(1,), (3,)]
+
+
+def test_pattern_cannot_cross_partitions(mr_engine):
+    mr_engine.execute("create table pi (p varchar, ts bigint, x bigint)")
+    mr_engine.execute("insert into pi values ('p1',1,1),('p2',1,1)")
+    rows = mr_engine.query("""
+      select * from pi match_recognize (
+        partition by p order by ts
+        measures first(a.ts) as f
+        one row per match
+        pattern (a a)
+        define a as x = 1
+      )
+    """)
+    assert rows == []
+
+
+def test_optional_quantifier(mr_engine):
+    mr_engine.execute("create table oq (ts bigint, x bigint)")
+    mr_engine.execute("insert into oq values (1,1),(2,3),(3,1),(4,2),(5,3)")
+    rows = mr_engine.query("""
+      select * from oq match_recognize (
+        order by ts
+        measures first(a.ts) as f, last(c.ts) as l
+        one row per match
+        after match skip past last row
+        pattern (a b? c)
+        define a as x = 1, b as x = 2, c as x = 3
+      )
+    """)
+    # match1: a=ts1, b absent, c=ts2; match2: a=ts3, b=ts4, c=ts5
+    assert rows == [(1, 2), (3, 5)]
+
+
+def test_measure_arithmetic_over_primitives(mr_engine):
+    rows = mr_engine.query("""
+      select sym, delta from ticks match_recognize (
+        partition by sym order by ts
+        measures last(up.price) - first(down.price) as delta
+        one row per match
+        pattern (down+ up+)
+        define down as price < prev(price), up as price > prev(price)
+      )
+    """)
+    # a: 12.0 - 8.0; b: 7.0 - 4.0
+    assert rows == [("a", 4.0), ("b", 3.0)]
+
+
+def test_match_number_counts_per_partition(mr_engine):
+    mr_engine.execute("create table mn (p varchar, ts bigint, x bigint)")
+    mr_engine.execute(
+        "insert into mn values ('p1',1,1),('p1',2,1),('p2',1,1),('p2',2,1)"
+    )
+    rows = mr_engine.query("""
+      select * from mn match_recognize (
+        partition by p order by ts
+        measures match_number() as mno, first(a.ts) as f
+        one row per match
+        after match skip past last row
+        pattern (a)
+        define a as x = 1
+      )
+    """)
+    assert rows == [("p1", 1, 1), ("p1", 2, 2), ("p2", 1, 1), ("p2", 2, 2)]
+
+
+def test_star_quantifier_and_undefined_label(mr_engine):
+    rows = mr_engine.query("""
+      select * from seq match_recognize (
+        order by ts
+        measures first(a.ts) as f, last(b.ts) as l
+        one row per match
+        pattern (a b*)
+        define a as x = 1
+      )
+    """)
+    # b undefined -> always matches; greedy b* takes the rest of the rows
+    assert rows == [(1, 5)]
+
+
+def test_match_recognize_as_subquery_input(mr_engine):
+    """The MATCH_RECOGNIZE relation composes with downstream operators."""
+    rows = mr_engine.query("""
+      select count(*), max(end_ts) from (
+        select * from ticks match_recognize (
+          partition by sym order by ts
+          measures last(up.ts) as end_ts
+          one row per match
+          pattern (down+ up+)
+          define down as price < prev(price), up as price > prev(price)
+        )
+      )
+    """)
+    assert rows == [(2, 5)]
+
+
+def test_null_partition_keys_group_together(mr_engine):
+    """NULL partition-key rows form ONE partition (garbage under the
+    validity mask must not split the run)."""
+    mr_engine.execute("create table npk (p bigint, ts bigint, x bigint)")
+    mr_engine.execute(
+        "insert into npk values (null,1,1),(null,2,1),(1,1,1),(1,2,1)"
+    )
+    rows = mr_engine.query("""
+      select * from npk match_recognize (
+        partition by p order by ts
+        measures first(a.ts) as f, last(a.ts) as l
+        one row per match
+        after match skip past last row
+        pattern (a a)
+        define a as x = 1
+      )
+    """)
+    # both the NULL partition and partition 1 match across their two rows
+    assert sorted(rows, key=lambda r: (r[0] is None, r)) == [
+        (1, 1, 2), (None, 1, 2)
+    ]
+
+
+def test_nested_prev_navigation(mr_engine):
+    """PREV over an expression containing another PREV (nested lowering)."""
+    mr_engine.execute("create table nv2 (ts bigint, x double)")
+    mr_engine.execute(
+        "insert into nv2 values (1,1.0),(2,2.0),(3,4.0),(4,5.0)"
+    )
+    rows = mr_engine.query("""
+      select * from nv2 match_recognize (
+        order by ts
+        measures first(a.ts) as at
+        one row per match
+        after match skip past last row
+        pattern (a)
+        define a as x - prev(x) > prev(x - prev(x))
+      )
+    """)
+    # ts3: delta=2 > prev delta=1 -> match; ts4: delta=1 > 2 false;
+    # ts2: prev delta is NULL -> false
+    assert rows == [(3,)]

@@ -2012,3 +2012,499 @@ group by i_brand, i_brand_id, t_hour, t_minute
 order by ext_price desc, i_brand_id
 """
 ORDERED["q71"] = False  # ext_price ties
+
+QUERIES["q74"] = """
+with year_total as (
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name, d_year as yr,
+        sum(ss_net_paid) year_total, 's' sale_type
+ from customer, store_sales, date_dim
+ where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+   and d_year in (2000, 2001)
+ group by c_customer_id, c_first_name, c_last_name, d_year
+ union all
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name, d_year as yr,
+        sum(ws_net_paid) year_total, 'w' sale_type
+ from customer, web_sales, date_dim
+ where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+   and d_year in (2000, 2001)
+ group by c_customer_id, c_first_name, c_last_name, d_year
+)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's' and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's' and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.yr = 2000 and t_s_secyear.yr = 2001
+  and t_w_firstyear.yr = 2000 and t_w_secyear.yr = 2001
+  and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else null end
+    > case when t_s_firstyear.year_total > 0
+           then t_s_secyear.year_total / t_s_firstyear.year_total
+           else null end
+order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name
+limit 100
+"""
+ORDERED["q74"] = True
+
+QUERIES["q11"] = """
+with year_total as (
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name,
+        c_preferred_cust_flag customer_preferred_cust_flag,
+        c_birth_country customer_birth_country, d_year dyear,
+        sum(ss_ext_list_price - ss_ext_discount_amt) year_total, 's' sale_type
+ from customer, store_sales, date_dim
+ where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+ group by c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag,
+          c_birth_country, d_year
+ union all
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name,
+        c_preferred_cust_flag customer_preferred_cust_flag,
+        c_birth_country customer_birth_country, d_year dyear,
+        sum(ws_ext_list_price - ws_ext_discount_amt) year_total, 'w' sale_type
+ from customer, web_sales, date_dim
+ where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+ group by c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag,
+          c_birth_country, d_year
+)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's' and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's' and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.dyear = 2000 and t_s_secyear.dyear = 2001
+  and t_w_firstyear.dyear = 2000 and t_w_secyear.dyear = 2001
+  and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else 0.0 end
+    > case when t_s_firstyear.year_total > 0
+           then t_s_secyear.year_total / t_s_firstyear.year_total
+           else 0.0 end
+order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_preferred_cust_flag
+limit 100
+"""
+ORDERED["q11"] = True
+
+QUERIES["q04"] = """
+with year_total as (
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name, d_year dyear,
+        sum(((ss_ext_list_price - ss_ext_wholesale_cost - ss_ext_discount_amt)
+             + ss_ext_sales_price) / 2) year_total, 's' sale_type
+ from customer, store_sales, date_dim
+ where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+ group by c_customer_id, c_first_name, c_last_name, d_year
+ union all
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name, d_year dyear,
+        sum(((cs_ext_list_price - cs_ext_wholesale_cost - cs_ext_discount_amt)
+             + cs_ext_sales_price) / 2) year_total, 'c' sale_type
+ from customer, catalog_sales, date_dim
+ where c_customer_sk = cs_bill_customer_sk and cs_sold_date_sk = d_date_sk
+ group by c_customer_id, c_first_name, c_last_name, d_year
+ union all
+ select c_customer_id customer_id, c_first_name customer_first_name,
+        c_last_name customer_last_name, d_year dyear,
+        sum(((ws_ext_list_price - ws_ext_wholesale_cost - ws_ext_discount_amt)
+             + ws_ext_sales_price) / 2) year_total, 'w' sale_type
+ from customer, web_sales, date_dim
+ where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+ group by c_customer_id, c_first_name, c_last_name, d_year
+)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_c_secyear.customer_id
+  and t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.sale_type = 's' and t_c_firstyear.sale_type = 'c'
+  and t_w_firstyear.sale_type = 'w' and t_s_secyear.sale_type = 's'
+  and t_c_secyear.sale_type = 'c' and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.dyear = 2000 and t_s_secyear.dyear = 2001
+  and t_c_firstyear.dyear = 2000 and t_c_secyear.dyear = 2001
+  and t_w_firstyear.dyear = 2000 and t_w_secyear.dyear = 2001
+  and t_s_firstyear.year_total > 0 and t_c_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+    > case when t_s_firstyear.year_total > 0
+           then t_s_secyear.year_total / t_s_firstyear.year_total
+           else null end
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+    > case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else null end
+order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name
+limit 100
+"""
+ORDERED["q04"] = True
+
+QUERIES["q91"] = """
+select cc_call_center_id as call_center, cc_name as call_center_name,
+       cc_manager as manager, sum(cr_net_loss) as returns_loss
+from call_center, catalog_returns, date_dim, customer,
+     customer_demographics, household_demographics, customer_address
+where cr_call_center_sk = cc_call_center_sk
+  and cr_returned_date_sk = d_date_sk
+  and cr_returning_customer_sk = c_customer_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and ca_address_sk = c_current_addr_sk
+  and d_year = 2000 and d_moy = 11
+  and ((cd_marital_status = 'M' and cd_education_status = 'Unknown')
+    or (cd_marital_status = 'W' and cd_education_status = 'Advanced Degree'))
+  and hd_buy_potential like 'Unknown%'
+  and ca_gmt_offset = -6
+group by cc_call_center_id, cc_name, cc_manager, cd_marital_status,
+         cd_education_status
+order by returns_loss desc
+"""
+ORDERED["q91"] = False
+
+QUERIES["q92"] = """
+select sum(ws_ext_discount_amt) as excess_discount_amount
+from web_sales, item, date_dim
+where i_manufact_id < 200
+  and i_item_sk = ws_item_sk
+  and d_date between date '2000-01-27' and date '2000-01-27' + interval '90' day
+  and d_date_sk = ws_sold_date_sk
+  and ws_ext_discount_amt > (
+      select 1.3 * avg(ws_ext_discount_amt)
+      from web_sales ws2, date_dim d2
+      where ws2.ws_item_sk = i_item_sk
+        and d2.d_date between date '2000-01-27'
+                          and date '2000-01-27' + interval '90' day
+        and d2.d_date_sk = ws2.ws_sold_date_sk)
+order by excess_discount_amount
+limit 100
+"""
+ORDERED["q92"] = True
+
+
+QUERIES["q70"] = """
+select sum(ss_net_profit) as total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) as lochierarchy,
+       rank() over (partition by grouping(s_state) + grouping(s_county),
+                    case when grouping(s_county) = 0 then s_state end
+                    order by sum(ss_net_profit) desc) as rank_within_parent
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 96 and 107
+  and d1.d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_state in
+      (select s_state from
+         (select s_state as s_state,
+                 rank() over (partition by s_state
+                              order by sum(ss_net_profit) desc) as ranking
+          from store_sales, store, date_dim
+          where d_month_seq between 96 and 107
+            and d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+          group by s_state) tmp1
+       where ranking <= 5)
+group by rollup(s_state, s_county)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent
+limit 100
+"""
+ORDERED["q70"] = False
+
+QUERIES["q86"] = """
+select sum(ws_net_paid) as total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ws_net_paid) desc) as rank_within_parent
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 96 and 107
+  and d1.d_date_sk = ws_sold_date_sk
+  and i_item_sk = ws_item_sk
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+ORDERED["q86"] = False
+
+QUERIES["q76"] = """
+select channel, col_name, d_year, d_qoy, i_category, count(*) sales_cnt,
+       sum(ext_sales_price) sales_amt
+from (
+  select 'store' as channel, 'ss_promo_sk' col_name, d_year, d_qoy,
+         i_category, ss_ext_sales_price ext_sales_price
+  from store_sales, item, date_dim
+  where ss_promo_sk is null and ss_sold_date_sk = d_date_sk
+    and ss_item_sk = i_item_sk
+  union all
+  select 'web' as channel, 'ws_promo_sk' col_name, d_year, d_qoy,
+         i_category, ws_ext_sales_price ext_sales_price
+  from web_sales, item, date_dim
+  where ws_promo_sk is null and ws_sold_date_sk = d_date_sk
+    and ws_item_sk = i_item_sk
+  union all
+  select 'catalog' as channel, 'cs_promo_sk' col_name, d_year, d_qoy,
+         i_category, cs_ext_sales_price ext_sales_price
+  from catalog_sales, item, date_dim
+  where cs_promo_sk is null and cs_sold_date_sk = d_date_sk
+    and cs_item_sk = i_item_sk) foo
+group by channel, col_name, d_year, d_qoy, i_category
+order by channel, col_name, d_year, d_qoy, i_category
+limit 100
+"""
+ORDERED["q76"] = True
+
+QUERIES["q79"] = """
+select c_last_name, c_first_name, city, ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, s_city as city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (hd_dep_count = 6 or hd_vehicle_count > 2)
+        and d_dow = 1 and d_year in (1999, 2000, 2001)
+        and s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, city, profit, ss_ticket_number
+limit 100
+"""
+ORDERED["q79"] = True
+
+QUERIES["q80"] = """
+with ssr as
+ (select s_store_id as store_id, sum(ss_ext_sales_price) as sales,
+         sum(coalesce(sr_return_amt, 0)) as returns_amt,
+         sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit
+  from store_sales left outer join store_returns
+         on (ss_item_sk = sr_item_sk and ss_ticket_number = sr_ticket_number),
+       date_dim, store, item, promotion
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+                   and date '2000-08-23' + interval '30' day
+    and ss_store_sk = s_store_sk and ss_item_sk = i_item_sk
+    and i_current_price > 50 and ss_promo_sk = p_promo_sk
+    and p_channel_tv = 'N'
+  group by s_store_id),
+ csr as
+ (select cp_catalog_page_id as catalog_page_id, sum(cs_ext_sales_price) as sales,
+         sum(coalesce(cr_return_amount, 0)) as returns_amt,
+         sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit
+  from catalog_sales left outer join catalog_returns
+         on (cs_item_sk = cr_item_sk and cs_order_number = cr_order_number),
+       date_dim, catalog_page, item, promotion
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+                   and date '2000-08-23' + interval '30' day
+    and cs_catalog_page_sk = cp_catalog_page_sk and cs_item_sk = i_item_sk
+    and i_current_price > 50 and cs_promo_sk = p_promo_sk
+    and p_channel_tv = 'N'
+  group by cp_catalog_page_id),
+ wsr as
+ (select web_site_id, sum(ws_ext_sales_price) as sales,
+         sum(coalesce(wr_return_amt, 0)) as returns_amt,
+         sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit
+  from web_sales left outer join web_returns
+         on (ws_item_sk = wr_item_sk and ws_order_number = wr_order_number),
+       date_dim, web_site, item, promotion
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+                   and date '2000-08-23' + interval '30' day
+    and ws_web_site_sk = web_site_sk and ws_item_sk = i_item_sk
+    and i_current_price > 50 and ws_promo_sk = p_promo_sk
+    and p_channel_tv = 'N'
+  group by web_site_id)
+select channel, id, sum(sales) as sales, sum(returns_amt) as returns_amt,
+       sum(profit) as profit
+from (select 'store channel' as channel, store_id as id, sales,
+             returns_amt, profit
+      from ssr
+      union all
+      select 'catalog channel' as channel, catalog_page_id as id, sales,
+             returns_amt, profit
+      from csr
+      union all
+      select 'web channel' as channel, web_site_id as id, sales,
+             returns_amt, profit
+      from wsr) x
+group by rollup (channel, id)
+order by channel, id
+limit 100
+"""
+ORDERED["q80"] = True
+
+QUERIES["q81"] = """
+with customer_total_return as
+ (select cr_returning_customer_sk as ctr_customer_sk, ca_state as ctr_state,
+         sum(cr_return_amt_inc_tax) as ctr_total_return
+  from catalog_returns, date_dim, customer_address
+  where cr_returned_date_sk = d_date_sk and d_year = 2000
+    and cr_returning_addr_sk = ca_address_sk
+  group by cr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+       ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+       ca_location_type, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk and ca_state = 'IL'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+         ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+         ca_location_type, ctr_total_return
+limit 100
+"""
+ORDERED["q81"] = True
+
+QUERIES["q82"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 30 and 60
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2000-05-25' and date '2000-05-25' + interval '60' day
+  and i_manufact_id in (6, 17, 27, 34)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+ORDERED["q82"] = True
+
+QUERIES["q83"] = """
+with sr_items as
+ (select i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+  from store_returns, item, date_dim
+  where sr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in (select d_week_seq from date_dim
+                                        where d_date in (date '2000-06-30',
+                                                         date '2000-09-27',
+                                                         date '2000-11-17')))
+    and sr_returned_date_sk = d_date_sk
+  group by i_item_id),
+ cr_items as
+ (select i_item_id item_id, sum(cr_return_quantity) cr_item_qty
+  from catalog_returns, item, date_dim
+  where cr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in (select d_week_seq from date_dim
+                                        where d_date in (date '2000-06-30',
+                                                         date '2000-09-27',
+                                                         date '2000-11-17')))
+    and cr_returned_date_sk = d_date_sk
+  group by i_item_id),
+ wr_items as
+ (select i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+  from web_returns, item, date_dim
+  where wr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in (select d_week_seq from date_dim
+                                        where d_date in (date '2000-06-30',
+                                                         date '2000-09-27',
+                                                         date '2000-11-17')))
+    and wr_returned_date_sk = d_date_sk
+  group by i_item_id)
+select sr_items.item_id,
+       sr_item_qty,
+       sr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 sr_dev,
+       cr_item_qty,
+       cr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 cr_dev,
+       wr_item_qty,
+       wr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 average
+from sr_items, cr_items, wr_items
+where sr_items.item_id = cr_items.item_id
+  and sr_items.item_id = wr_items.item_id
+order by sr_items.item_id, sr_item_qty
+limit 100
+"""
+ORDERED["q83"] = True
+
+QUERIES["q84"] = """
+select c_customer_id as customer_id,
+       c_last_name || ', ' || c_first_name as customername
+from customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+where ca_city = 'Midway'
+  and c_current_addr_sk = ca_address_sk
+  and ib_lower_bound >= 10001 and ib_upper_bound <= 70000
+  and ib_income_band_sk = hd_income_band_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and sr_cdemo_sk = cd_demo_sk
+order by c_customer_id, customername
+limit 100
+"""
+ORDERED["q84"] = True
+
+QUERIES["q85"] = """
+select substring(r_reason_desc, 1, 20) as reason_prefix,
+       avg(ws_quantity) as avg_qty, avg(wr_refunded_cash) as avg_cash,
+       avg(wr_fee) as avg_fee
+from web_sales, web_returns, web_page, customer_demographics cd1,
+     customer_demographics cd2, customer_address, date_dim, reason
+where ws_web_page_sk = wp_web_page_sk
+  and ws_item_sk = wr_item_sk and ws_order_number = wr_order_number
+  and ws_sold_date_sk = d_date_sk and d_year = 2000
+  and cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  and cd2.cd_demo_sk = wr_returning_cdemo_sk
+  and ca_address_sk = wr_refunded_addr_sk
+  and r_reason_sk = wr_reason_sk
+  and ((cd1.cd_marital_status = 'M'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = 'Advanced Degree'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 100 and 150)
+    or (cd1.cd_marital_status = 'S'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = 'College'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 50 and 100)
+    or (cd1.cd_marital_status = 'W'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = '2 yr Degree'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 150 and 200))
+  and ((ca_country = 'United States' and ca_state in ('IL', 'OH', 'TX')
+        and ws_net_profit between 100 and 200)
+    or (ca_country = 'United States' and ca_state in ('CA', 'GA', 'NY')
+        and ws_net_profit between 150 and 300)
+    or (ca_country = 'United States' and ca_state in ('MI', 'TN')
+        and ws_net_profit between 50 and 250))
+group by r_reason_desc
+order by reason_prefix, avg_qty, avg_cash, avg_fee
+limit 100
+"""
+ORDERED["q85"] = True

@@ -46,14 +46,39 @@ class TableStats:
     columns: dict  # name -> ColumnStats
 
 
-def compute_table_stats(data: dict, max_ndv_rows: int = 50_000_000) -> TableStats:
-    """Exact stats from in-memory columns (generator/memory connectors).
-    NDV costs one numpy sort per column: numeric columns up to
-    max_ndv_rows, object (string) columns only below 4M rows."""
+# Above this row count NDV comes from a fixed-size random sample (the
+# reference likewise estimates NDV — ANALYZE collects HLL sketches, not
+# exact counts).  Exact np.unique over an SF1 lineitem column is an 18s
+# sort per column; planning must not scan the data it is planning over.
+_NDV_SAMPLE_ROWS = 262_144
+
+
+def _estimate_ndv(base: np.ndarray, n_total: int, rng_seed: int = 0) -> float:
+    """NDV from a uniform sample via the GEE estimator of Charikar et al.
+    (sqrt(n/r) correction for singletons): d_hat = sqrt(n/r)*f1 + (d_s - f1)
+    where d_s = distinct-in-sample, f1 = values seen exactly once."""
+    r = len(base)
+    if r == 0:
+        return 0.0
+    _, counts = np.unique(base, return_counts=True)
+    d_s = float(len(counts))
+    if r >= n_total:
+        return d_s
+    f1 = float((counts == 1).sum())
+    d_hat = np.sqrt(n_total / r) * f1 + (d_s - f1)
+    return float(min(max(d_hat, d_s), n_total))
+
+
+def compute_table_stats(data: dict, max_ndv_rows: int = _NDV_SAMPLE_ROWS) -> TableStats:
+    """Stats from in-memory columns (generator/memory connectors).
+    min/max/null-fraction are exact (cheap vectorized passes); NDV is exact
+    up to max_ndv_rows and GEE-sample-estimated above it, so planning cost
+    stays O(sample) regardless of table size."""
     if not data:
         return TableStats(0.0, {})
     n = len(next(iter(data.values())))
     cols = {}
+    samples: dict[int, np.ndarray] = {}  # per column length (null counts vary)
     for name, arr in data.items():
         nulls = 0.0
         base = arr
@@ -61,11 +86,17 @@ def compute_table_stats(data: dict, max_ndv_rows: int = 50_000_000) -> TableStat
             nulls = float(np.ma.getmaskarray(arr).sum()) / max(n, 1)
             base = arr.compressed()
         ndv = mn = mx = None
-        is_obj = base.dtype == object
-        ndv_cap = 4_000_000 if is_obj else max_ndv_rows
-        if len(base) and n <= ndv_cap:
-            ndv = float(len(np.unique(base)))
-        if len(base) and not is_obj and np.issubdtype(base.dtype, np.number):
+        if len(base):
+            if len(base) <= max_ndv_rows:
+                ndv = float(len(np.unique(base)))
+            else:
+                take = samples.get(len(base))
+                if take is None:
+                    rng = np.random.default_rng(0xD5)
+                    take = rng.integers(0, len(base), _NDV_SAMPLE_ROWS)
+                    samples[len(base)] = take
+                ndv = _estimate_ndv(base[take], len(base))
+        if len(base) and base.dtype != object and np.issubdtype(base.dtype, np.number):
             mn = float(base.min())
             mx = float(base.max())
         cols[name] = ColumnStats(ndv, mn, mx, nulls)

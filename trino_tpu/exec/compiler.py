@@ -35,8 +35,8 @@ from ..ops.relops import (
 )
 from ..plan.nodes import (
     Aggregate, Concat, Distinct, EnforceSingleRow, Exchange, Filter, Join,
-    Limit, PlanNode, Project, RemoteSource, Sort, TableScan, TopN, Unnest,
-    Values, Window,
+    Limit, MatchRecognize, PlanNode, Project, RemoteSource, Sort, TableScan,
+    TopN, Unnest, Values, Window,
 )
 
 __all__ = ["LocalExecutor"]
@@ -251,7 +251,17 @@ class LocalExecutor:
                 inputs[str(i)] = remote_pages[n.fragment_id]
         caps = self._learned_caps.get(plan)
         if caps is None:
-            caps = self._initial_caps(nodes, inputs)
+            from .capcache import load_caps
+
+            cached = load_caps(plan, inputs)
+            init = self._initial_caps(nodes, inputs)
+            # a cached entry from an older code version may size fewer node
+            # kinds than the current tracer reads — only trust it when it
+            # covers every currently-sized node (else KeyError mid-trace)
+            if cached is not None and set(cached) >= set(init):
+                caps = cached
+        if caps is None:
+            caps = init
             total_rows = sum(p.capacity for p in inputs.values())
             if total_rows <= _EAGER_SIZING_LIMIT:
                 # Converge capacities EAGERLY (op-by-op dispatch, per-op jit
@@ -293,6 +303,9 @@ class LocalExecutor:
             }
             if not overflow:
                 self._learned_caps[plan] = caps
+                from .capcache import store_caps
+
+                store_caps(plan, inputs, caps)
                 return out_page
             for nid, req in overflow.items():
                 caps[nid] = _pow2(max(req, caps[nid] * 2))
@@ -385,8 +398,15 @@ class LocalExecutor:
                 return caps[nid]
             if isinstance(n, TopN):
                 # radix-select candidate buffer (ops/relops.py top_n): room
-                # for K plus boundary ties; sort fallback never overflows it
-                caps[nid] = min(_pow2(2 * n.count + 512), _pow2(max(child_sizes[0], 1)))
+                # for K plus boundary ties; sort fallback never overflows it.
+                # 16k floor: the 32-bit radix threshold over a float key can
+                # tie thousands of rows, and an undersized guess costs a
+                # whole-plan recompile (q03 SF1: 215s wasted on the retry) —
+                # 16k extra lanes in the candidate sort cost microseconds
+                caps[nid] = min(
+                    _pow2(max(2 * n.count + 512, 16384)),
+                    _pow2(max(child_sizes[0], 1)),
+                )
                 return min(n.count, child_sizes[0])
             if isinstance(n, Unnest):
                 # unknown fan-out: guess 4x, the retry loop corrects
@@ -465,11 +485,15 @@ class LocalExecutor:
 
 
 def _has_host_aggs(plan: PlanNode) -> bool:
+    """Plans that must run eagerly: host-collected aggregates intern
+    structured values on the host, and MATCH_RECOGNIZE's backtracking walk
+    is a host loop (reference: Matcher.java is likewise interpretive)."""
     from ..ops.relops import HOST_AGGS
     from ..plan.nodes import walk
 
     return any(
-        isinstance(n, Aggregate) and any(a.fn in HOST_AGGS for a in n.aggs)
+        isinstance(n, MatchRecognize)
+        or (isinstance(n, Aggregate) and any(a.fn in HOST_AGGS for a in n.aggs))
         for n in walk(plan)
     )
 
@@ -604,8 +628,15 @@ def _trace_plan(
                 for a in node.aggs
             ]
             specs = [AggSpec(a.fn, a.distinct, a.param, a.sep) for a in node.aggs]
+            aorder = [
+                tuple(
+                    (eval_expr(k, s.cols, s.capacity), asc, nf)
+                    for k, asc, nf in a.order_keys
+                )
+                for a in node.aggs
+            ]
             out_keys, out_aggs, out_live, n_groups = group_aggregate(
-                keys, args, specs, s.live, G, agg_args2=args2
+                keys, args, specs, s.live, G, agg_args2=args2, agg_order=aorder
             )
             report(nid, n_groups)
             cols: list[ColumnVal] = []
@@ -738,6 +769,15 @@ def _trace_plan(
                 s.cols, s.live, keys, num_devices, B, axis
             )
             report(nid, req)
+            return _Stage(cols, live)
+
+        if isinstance(node, MatchRecognize):
+            # host-side operator (sequential backtracking walk; the plan is
+            # forced onto the eager path, like host-collected aggregates)
+            from ..ops.matchrec import execute_match
+
+            s = emit(node.child)
+            cols, live = execute_match(node, s.cols, s.live)
             return _Stage(cols, live)
 
         if isinstance(node, Values):

@@ -1373,19 +1373,34 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         # branch's codes into union space, select codes on device — the same
         # per-distinct-value strategy as every other string op here
         branches = [out] + [r for _, r in evaluated]
-        if any(b.dict is None for b in branches):
+        # dict-less varchar branches are NULL literals (all varchar columns
+        # are dictionary-coded): their codes never surface through the
+        # all-false validity mask, so they contribute nothing to the union
+        # (e.g. `case when grouping(k) = 0 then k end` — implicit NULL else)
+        if any(
+            b.dict is None and not (b.type is None or b.type.is_string)
+            for b in branches
+        ):
             raise NotImplementedError("CASE mixing varchar and non-varchar results")
         union = np.unique(
-            np.concatenate([np.asarray(b.dict.values, dtype=object) for b in branches])
+            np.concatenate([
+                np.asarray(b.dict.values, dtype=object)
+                for b in branches if b.dict is not None
+            ])
         )
         udict = Dictionary(union)
 
-        def remap(b: ColumnVal) -> jnp.ndarray:
+        def remap(b: ColumnVal) -> ColumnVal:
+            if b.dict is None:  # NULL branch: any code, validity masks it
+                return ColumnVal(
+                    jnp.zeros(b.data.shape, jnp.int32), b.valid, udict, e.type
+                )
             table = np.searchsorted(union, np.asarray(b.dict.values, dtype=object))
-            return jnp.take(jnp.asarray(table.astype(np.int32)), b.data)
+            codes = jnp.take(jnp.asarray(table.astype(np.int32)), b.data)
+            return ColumnVal(codes, b.valid, udict, e.type)
 
-        out = ColumnVal(remap(out), out.valid, udict, e.type)
-        evaluated = [(c, ColumnVal(remap(r), r.valid, udict, e.type)) for c, r in evaluated]
+        out = remap(out)
+        evaluated = [(c, remap(r)) for c, r in evaluated]
     out_data, out_valid = out.data, out.valid
     result_dict = out.dict
     for c, r in reversed(evaluated):

@@ -26,7 +26,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["compile_pattern", "find_matches"]
+__all__ = ["compile_pattern", "find_matches", "host_sort_rank"]
 
 _MAX_REPEAT_UNROLL = 64  # {n,m} unroll guard
 _STEP_BUDGET_FACTOR = 512  # backtracking step cap per start row (VM safety)
@@ -200,3 +200,262 @@ def find_matches(
                 start = last_row + 1
         i = p_end
     return out
+
+
+# --------------------------------------------------------------- execution
+# The full MATCH_RECOGNIZE operator: sort -> vectorized DEFINE masks ->
+# host VM walk -> measure evaluation.  Runs host-side over concrete arrays
+# (the LocalExecutor forces the eager path for plans containing a
+# MatchRecognize node, exactly as for host-collected aggregates): the walk
+# is inherently sequential under AFTER MATCH SKIP semantics, matching the
+# reference's single-threaded per-partition Matcher
+# (operator/window/matcher/Matcher.java:28).
+
+
+def host_sort_rank(data: np.ndarray, valid, dictionary, ascending: bool,
+                   nulls_first: bool) -> tuple[np.ndarray, np.ndarray]:
+    """(null_rank, value_rank) int arrays for np.lexsort, encoding NULL
+    placement and direction (dictionary codes are unordered, so string keys
+    rank through their decoded values).  Shared by the MATCH_RECOGNIZE
+    global sort and relops' ordered host-collected aggregates."""
+    n = len(data)
+    if dictionary is not None:
+        decoded = dictionary.values[np.clip(data, 0, max(len(dictionary) - 1, 0))]
+        _, rank = np.unique(decoded, return_inverse=True)
+    else:
+        try:
+            _, rank = np.unique(data, return_inverse=True)
+        except TypeError:  # mixed-type object lanes: rank by repr
+            _, rank = np.unique(
+                np.asarray([repr(v) for v in data], dtype=object),
+                return_inverse=True,
+            )
+    rank = rank.astype(np.int64)
+    if not ascending:
+        rank = -rank
+    if valid is None:
+        null_rank = np.zeros(n, dtype=np.int8)
+    else:
+        is_null = ~np.asarray(valid)
+        null_rank = np.where(is_null, -1 if nulls_first else 1, 0).astype(np.int8)
+        rank = np.where(is_null, 0, rank)
+    return null_rank, rank
+
+
+def execute_match(node, cols, live):
+    """Execute a MatchRecognize plan node over concrete columns.
+
+    cols: list[ColumnVal] (child schema), live: bool array.
+    Returns (out_cols: list[ColumnVal], out_live: np.ndarray).
+    """
+    import jax.numpy as jnp
+
+    from ..data.page import Dictionary
+    from .expr import ColumnVal, eval_expr, eval_predicate
+
+    live_np = np.asarray(live)
+    sel = np.nonzero(live_np)[0]
+    n = len(sel)
+
+    def compact(cv: ColumnVal) -> ColumnVal:
+        data = np.asarray(cv.data)[sel]
+        valid = None if cv.valid is None else np.asarray(cv.valid)[sel]
+        return ColumnVal(jnp.asarray(data), None if valid is None else jnp.asarray(valid),
+                         cv.dict, cv.type)
+
+    ccols = [compact(c) for c in cols]
+
+    # ---- 1. global sort: partition keys, then ORDER BY keys -------------
+    pkeys = [eval_expr(k, ccols, n) for k in node.partition_keys]
+    okeys = [eval_expr(sk.expr, ccols, n) for sk in node.order_keys]
+    lex: list[np.ndarray] = []  # np.lexsort: LAST array is the primary key
+    for sk, kv in reversed(list(zip(node.order_keys, okeys))):
+        nr, r = host_sort_rank(np.asarray(kv.data),
+                           None if kv.valid is None else np.asarray(kv.valid),
+                           kv.dict, sk.ascending, sk.nulls_first)
+        lex.append(r)
+        lex.append(nr)
+    for kv in reversed(pkeys):
+        nr, r = host_sort_rank(np.asarray(kv.data),
+                           None if kv.valid is None else np.asarray(kv.valid),
+                           kv.dict, True, True)
+        lex.append(r)
+        lex.append(nr)
+    order = np.lexsort(lex) if lex else np.arange(n)
+
+    def take(cv: ColumnVal) -> ColumnVal:
+        data = np.asarray(cv.data)[order]
+        valid = None if cv.valid is None else np.asarray(cv.valid)[order]
+        return ColumnVal(jnp.asarray(data), None if valid is None else jnp.asarray(valid),
+                         cv.dict, cv.type)
+
+    scols = [take(c) for c in ccols]
+    spkeys = [take(k) for k in pkeys]
+
+    # ---- 2. partition runs ---------------------------------------------
+    if pkeys and n:
+        same = np.ones(n, dtype=bool)
+        for kv in spkeys:
+            d = np.asarray(kv.data)
+            eq = d[1:] == d[:-1]
+            if kv.valid is not None:
+                # NULL keys group together: two rows match when both are
+                # NULL (garbage under the mask must not split the run) or
+                # both valid with equal data
+                v = np.asarray(kv.valid)
+                eq = np.where(~v[1:] & ~v[:-1], True, eq & v[1:] & v[:-1])
+            same[1:] &= eq
+        same[0] = False
+        part_start = np.maximum.accumulate(
+            np.where(~same, np.arange(n), 0))
+    else:
+        part_start = np.zeros(n, dtype=np.int64)
+
+    # ---- 3. PREV/NEXT shifted columns ----------------------------------
+    nav_cols = []
+    for inner, k in node.prev_exprs:
+        # nested navigation (PREV(x - PREV(x))): the planner lowers inner
+        # calls first, so expression j may reference FieldRef(C + i) for
+        # i < j — evaluate against child cols plus nav cols built so far
+        v = eval_expr(inner, scols + nav_cols, n)
+        data = np.asarray(v.data)
+        valid = np.ones(n, dtype=bool) if v.valid is None else np.asarray(v.valid).copy()
+        j = np.arange(n) - k  # k>0: PREV, k<0: NEXT
+        inb = (j >= 0) & (j < n)
+        jc = np.clip(j, 0, max(n - 1, 0))
+        inb &= part_start[jc] == part_start  # same partition only
+        data = np.where(inb, data[jc], np.zeros_like(data[:1]))
+        valid = np.where(inb, valid[jc], False)
+        nav_cols.append(ColumnVal(jnp.asarray(data), jnp.asarray(valid),
+                                  v.dict, v.type))
+
+    # ---- 4. vectorized DEFINE masks ------------------------------------
+    define_input = scols + nav_cols
+    L = len(node.labels)
+    masks = np.zeros((L, max(n, 1)), dtype=bool)
+    for li, ir in enumerate(node.defines):
+        masks[li, :n] = np.asarray(eval_predicate(ir, define_input, n))[:n]
+
+    # ---- 5. the walk ----------------------------------------------------
+    matches = find_matches(node.program, masks[:, :n], part_start,
+                           node.after_skip) if n else []
+
+    # ---- 6. primitive columns per output row ---------------------------
+    label_dict = Dictionary(np.asarray([l.upper() for l in node.labels],
+                                       dtype=object))
+
+    _field_cache: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+    def field_np(ix: int) -> tuple[np.ndarray, np.ndarray]:
+        # one device->host fetch per referenced field, NOT per output row
+        hit = _field_cache.get(ix)
+        if hit is None:
+            cv = scols[ix]
+            d = np.asarray(cv.data)
+            v = np.ones(n, dtype=bool) if cv.valid is None else np.asarray(cv.valid)
+            hit = _field_cache[ix] = (d, v)
+        return hit
+
+    out_rows: list[int] = []  # sorted-row index per output row (ALL ROWS)
+    prim_vals: list[list] = [[] for _ in node.prims]  # python values; None=NULL
+    match_of_row: list[tuple[int, list]] = []  # (mno, rows) per output row
+
+    if node.all_rows:
+        for mno, assigned in matches:
+            for pos, (row, lab) in enumerate(assigned):
+                out_rows.append(row)
+                match_of_row.append((mno, assigned[: pos + 1]))
+    else:
+        for mno, assigned in matches:
+            match_of_row.append((mno, assigned))
+
+    for pi, (kind, lab_ix, f_ix) in enumerate(node.prims):
+        vals = prim_vals[pi]
+        for mno, assigned in match_of_row:
+            if kind == "match_number":
+                vals.append(mno)
+                continue
+            if kind == "classifier":
+                # RUNNING (ALL ROWS): label of the current row;
+                # FINAL (ONE ROW): label of the last row of the match
+                vals.append(assigned[-1][1])
+                continue
+            rows = [r for r, l in assigned if lab_ix < 0 or l == lab_ix]
+            if not rows:
+                vals.append(None)
+                continue
+            r = rows[0] if kind == "first" else rows[-1]
+            d, v = field_np(f_ix)
+            vals.append(d[r].item() if v[r] else None)
+
+    m_out = len(match_of_row)
+
+    def prim_column(pi: int) -> ColumnVal:
+        kind = node.prims[pi][0]
+        tt = node.prim_types[pi]
+        vals = prim_vals[pi]
+        valid = np.asarray([v is not None for v in vals], dtype=bool)
+        if kind == "classifier":
+            data = np.asarray([v if v is not None else 0 for v in vals],
+                              dtype=np.int32)
+            return ColumnVal(jnp.asarray(data), jnp.asarray(valid),
+                             label_dict, tt)
+        f_ix = node.prims[pi][2]
+        dictionary = scols[f_ix].dict if f_ix >= 0 else None
+        data = np.asarray([v if v is not None else 0 for v in vals],
+                          dtype=tt.np_dtype)
+        return ColumnVal(jnp.asarray(data), jnp.asarray(valid), dictionary, tt)
+
+    prim_cols = [prim_column(i) for i in range(len(node.prims))]
+    measure_cols = [eval_expr(ir, prim_cols, max(m_out, 1))
+                    for ir in node.measures]
+
+    # slice consts/broadcasts down and pad everything to >= 1 row
+    cap = max(m_out, 1)
+
+    def fit(cv: ColumnVal) -> ColumnVal:
+        data = np.asarray(cv.data)
+        if data.shape[0] < cap:
+            data = np.concatenate(
+                [data, np.zeros((cap - data.shape[0],), dtype=data.dtype)])
+        else:
+            data = data[:cap]
+        valid = cv.valid
+        if valid is not None:
+            valid = np.asarray(valid)
+            if valid.shape[0] < cap:
+                valid = np.concatenate(
+                    [valid, np.zeros((cap - valid.shape[0],), dtype=bool)])
+            else:
+                valid = valid[:cap]
+            valid = jnp.asarray(valid)
+        return ColumnVal(jnp.asarray(data), valid, cv.dict, cv.type)
+
+    if node.all_rows:
+        rows_idx = np.asarray(out_rows, dtype=np.int64)
+
+        def gather(cv: ColumnVal) -> ColumnVal:
+            d = np.asarray(cv.data)[rows_idx] if m_out else np.asarray(cv.data)[:0]
+            v = None
+            if cv.valid is not None:
+                v = np.asarray(cv.valid)[rows_idx] if m_out else np.asarray(cv.valid)[:0]
+                v = jnp.asarray(v)
+            return ColumnVal(jnp.asarray(d), v, cv.dict, cv.type)
+
+        out_cols = [fit(gather(c)) for c in scols] + [fit(c) for c in measure_cols]
+    else:
+        first_rows = np.asarray(
+            [assigned[0][0] for _, assigned in match_of_row], dtype=np.int64)
+
+        def at_first(cv: ColumnVal) -> ColumnVal:
+            d = np.asarray(cv.data)[first_rows] if m_out else np.asarray(cv.data)[:0]
+            v = None
+            if cv.valid is not None:
+                v = np.asarray(cv.valid)[first_rows] if m_out else np.asarray(cv.valid)[:0]
+                v = jnp.asarray(v)
+            return ColumnVal(jnp.asarray(d), v, cv.dict, cv.type)
+
+        out_cols = [fit(at_first(k)) for k in spkeys] + [fit(c) for c in measure_cols]
+
+    out_live = np.arange(cap) < m_out
+    return out_cols, jnp.asarray(out_live)

@@ -167,6 +167,7 @@ def group_aggregate(
     live: jnp.ndarray,
     num_groups_cap: int,
     agg_args2: Optional[Sequence[Optional[ColumnVal]]] = None,
+    agg_order: Optional[Sequence[tuple]] = None,
 ):
     """Sort-based grouped aggregation.
 
@@ -179,9 +180,11 @@ def group_aggregate(
     G = num_groups_cap
     if agg_args2 is None:
         agg_args2 = [None] * len(specs)
+    if agg_order is None:
+        agg_order = [()] * len(specs)
 
     if not key_vals:
-        return _global_aggregate(agg_args, specs, live, agg_args2)
+        return _global_aggregate(agg_args, specs, live, agg_args2, agg_order)
 
     fast = _direct_code_aggregate(key_vals, agg_args, specs, live, agg_args2)
     if fast is not None:
@@ -260,7 +263,8 @@ def group_aggregate(
             continue
         if out_aggs[i] is None and spec.fn in HOST_AGGS:
             out_aggs[i] = _host_collect_agg(
-                spec, arg, agg_args2[i], perm, seg, live_s, G, n
+                spec, arg, agg_args2[i], perm, seg, live_s, G, n,
+                order=agg_order[i],
             )
             continue
         if out_aggs[i] is None:  # DISTINCT/percentile: need sorted adjacency
@@ -631,6 +635,7 @@ def _host_collect_agg(
     live_s: jnp.ndarray,
     G: int,
     n: int,
+    order: tuple = (),
 ):
     """array_agg / map_agg / listagg: per-group collection on the HOST over
     the sorted grouping (reference: aggregation/ArrayAggregationFunction,
@@ -665,6 +670,23 @@ def _host_collect_agg(
     bounds = np.flatnonzero(np.diff(gs)) + 1
     group_ids = gs[np.concatenate([[0], bounds])] if len(gs) else np.zeros(0, np.int64)
     runs = np.split(np.arange(len(gs)), bounds)
+
+    if order:
+        # ordered collection: sort each group's run by the agg's ORDER BY
+        # keys (reference: ordering-sensitive aggregation inputs,
+        # OrderingCompiler over PagesIndex)
+        from .matchrec import host_sort_rank
+
+        lex: list[np.ndarray] = []
+        for cv, asc, nulls_first in reversed(order):
+            d, ok = decode(cv)
+            null_rank, rank = host_sort_rank(
+                d[keep], ok[keep], None, asc, nulls_first
+            )
+            lex.append(rank)
+            lex.append(null_rank)
+        runs = [r[np.lexsort([k[r] for k in lex])] if len(r) > 1 else r
+                for r in runs]
 
     def _dedup_first(seq):
         seen: set = set()
@@ -786,7 +808,7 @@ def _segment_percentile(
     return vals, vcnt > 0
 
 
-def _global_aggregate(agg_args, specs, live, agg_args2=None):
+def _global_aggregate(agg_args, specs, live, agg_args2=None, agg_order=None):
     """No GROUP BY: one output row even over empty input (SQL semantics).
 
     Non-DISTINCT aggregates run through the fused segmented reduction with a
@@ -796,6 +818,8 @@ def _global_aggregate(agg_args, specs, live, agg_args2=None):
     n = live.shape[0]
     if agg_args2 is None:
         agg_args2 = [None] * len(specs)
+    if agg_order is None:
+        agg_order = [()] * len(specs)
     seg = jnp.zeros((n,), jnp.int32)
     fused = _fused_aggs(agg_args, specs, None, seg, live, 1, n, agg_args2=agg_args2)
     out_aggs = []
@@ -807,7 +831,8 @@ def _global_aggregate(agg_args, specs, live, agg_args2=None):
             perm1 = jnp.arange(n, dtype=jnp.int32)
             out_aggs.append(
                 _host_collect_agg(
-                    spec, arg, agg_args2[i], perm1, seg, live, 1, n
+                    spec, arg, agg_args2[i], perm1, seg, live, 1, n,
+                    order=agg_order[i],
                 )
             )
             continue

@@ -215,6 +215,27 @@ class _Distributor:
                 _Part("replicated"),
             )
 
+        from .nodes import MatchRecognize as _MR
+
+        if isinstance(node, _MR):
+            # like Window: pattern matching is per-partition sequential work,
+            # so hash-repartition on PARTITION BY (or gather when absent)
+            import dataclasses as _dc
+
+            child, part = self.visit(node.child)
+            if part.kind == "replicated":
+                return _dc.replace(node, child=child), part
+            if node.partition_keys:
+                already = part.kind == "hash" and all(
+                    any(k == p for p in node.partition_keys) for k in part.keys
+                )
+                if not already:
+                    child = Exchange(child, "repartition", node.partition_keys)
+                    part = _Part("hash", node.partition_keys)
+                return _dc.replace(node, child=child), part
+            child = Exchange(child, "gather")
+            return _dc.replace(node, child=child), _Part("replicated")
+
         raise NotImplementedError(f"distribute: {type(node).__name__}")
 
     # ------------------------------------------------------------- aggregate

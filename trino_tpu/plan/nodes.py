@@ -107,6 +107,10 @@ class AggCall:
     param: Optional[float] = None
     arg2: Optional[IrExpr] = None
     sep: Optional[str] = None
+    # ordering-sensitive collection: array_agg(x ORDER BY y),
+    # listagg(...) WITHIN GROUP (ORDER BY y) — triples of
+    # (key IR over child schema, ascending, nulls_first)
+    order_keys: tuple[tuple[IrExpr, bool, bool], ...] = ()
 
 
 @dataclass(frozen=True)

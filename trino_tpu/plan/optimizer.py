@@ -175,6 +175,8 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
             for a_arg in (node.aggs[i].arg, node.aggs[i].arg2):
                 if a_arg is not None:
                     child_needed |= field_refs(a_arg)
+            for k, _asc, _nf in node.aggs[i].order_keys:
+                child_needed |= field_refs(k)
         child, m = _prune(node.child, child_needed)
         new_keys = tuple(remap(k, m) for k in node.group_keys)
         new_aggs = tuple(
@@ -186,6 +188,10 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
                 node.aggs[i].param,
                 None if node.aggs[i].arg2 is None else remap(node.aggs[i].arg2, m),
                 node.aggs[i].sep,
+                tuple(
+                    (remap(k, m), asc, nf)
+                    for k, asc, nf in node.aggs[i].order_keys
+                ),
             )
             for i in keep_aggs
         )
@@ -335,5 +341,17 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         for pos, i in enumerate(keep_calls):
             mapping[nc + i] = new_nc + pos
         return new, mapping
+
+    from .nodes import MatchRecognize as _MR
+
+    if isinstance(node, _MR):
+        # opaque to pruning: DEFINE/MEASURES reference child fields through
+        # shifted-column and primitive indirection, so the child keeps its
+        # full schema and the node's outputs pass through unchanged
+        import dataclasses as _dc
+
+        child, _ = _prune(node.child, set(range(len(node.child.output_types))))
+        new = node if child is node.child else _dc.replace(node, child=child)
+        return new, {i: i for i in range(len(node.output_types))}
 
     raise NotImplementedError(f"prune: {type(node).__name__}")

@@ -740,16 +740,18 @@ class Planner:
             return _dc.replace(e, **changes) if changes else e
 
         def lower_prev(ir: IrExpr) -> IrExpr:
-            """Call('prev', (expr[, k])) subtrees -> FieldRef(C + j)."""
-            if isinstance(ir, Call) and ir.name == "prev":
+            """Call('prev'|'next', (expr[, k])) subtrees -> FieldRef(C + j).
+            NEXT is recorded as a negative shift (the executor shifts the
+            other way)."""
+            if isinstance(ir, Call) and ir.op in ("prev", "next"):
                 inner = ir.args[0]
                 k = 1
                 if len(ir.args) > 1:
                     if not isinstance(ir.args[1], Const):
-                        raise PlanningError("PREV offset must be a literal")
+                        raise PlanningError("PREV/NEXT offset must be a literal")
                     k = int(ir.args[1].value)
                 inner = lower_prev(inner)
-                prev_exprs.append((inner, k))
+                prev_exprs.append((inner, k if ir.op == "prev" else -k))
                 return FieldRef(C + len(prev_exprs) - 1, inner.type)
             import dataclasses as _dc
 
@@ -769,14 +771,18 @@ class Planner:
             return _dc.replace(ir, **changes) if changes else ir
 
         define_irs: list[IrExpr] = []
-        for lab in labels:
-            cond = def_map.get(lab)
-            if cond is None:
-                define_irs.append(Const(True, BOOLEAN))  # undefined: always ok
-                continue
-            stripped = strip_label(cond, lab)
-            ir = t.translate(stripped)
-            define_irs.append(_as_bool(lower_prev(ir)))
+        t.pattern_nav = True  # PREV/NEXT legal inside DEFINE conditions
+        try:
+            for lab in labels:
+                cond = def_map.get(lab)
+                if cond is None:
+                    define_irs.append(Const(True, BOOLEAN))  # undefined: always ok
+                    continue
+                stripped = strip_label(cond, lab)
+                ir = t.translate(stripped)
+                define_irs.append(_as_bool(lower_prev(ir)))
+        finally:
+            t.pattern_nav = False
 
         # ---- measures: rewrite primitives into a prim scope ---------------
         prims: list[tuple] = []
@@ -1028,9 +1034,23 @@ class Planner:
             agg_map[fc] = FieldRef(base + i, aggs[i].type)
         return RelationPlan(node, fields), agg_map
 
+    def _agg_order(self, fc: A.FuncCall, t: "_Translator"):
+        """Translate an aggregate's ORDER BY into (ir, asc, nulls_first)
+        triples over the child schema (reference: ordered aggregation inputs,
+        docs/src/main/sphinx/functions/aggregate.md)."""
+        return tuple(
+            (t.translate(si.expr), si.ascending, _nulls_first(si))
+            for si in fc.order_by
+        )
+
     def _build_agg_calls(self, agg_calls: list[A.FuncCall], t: "_Translator") -> list[AggCall]:
         aggs: list[AggCall] = []
         for fc in agg_calls:
+            if fc.order_by and fc.name not in ("array_agg", "listagg", "string_agg"):
+                raise PlanningError(
+                    f"ORDER BY in aggregate is only supported for "
+                    f"array_agg/listagg, not {fc.name}"
+                )
             if fc.name == "count" and not fc.args:
                 aggs.append(AggCall("count_star", None, BIGINT))
                 continue
@@ -1084,7 +1104,8 @@ class Planner:
                 from ..data.types import ArrayType
 
                 aggs.append(
-                    AggCall("array_agg", arg, ArrayType(arg.type), fc.distinct)
+                    AggCall("array_agg", arg, ArrayType(arg.type), fc.distinct,
+                            order_keys=self._agg_order(fc, t))
                 )
                 continue
             if name == "map_agg":
@@ -1104,7 +1125,8 @@ class Planner:
                     if not isinstance(sep_ir, Const):
                         raise PlanningError("listagg separator must be a literal")
                     sep = str(sep_ir.value)
-                aggs.append(AggCall("listagg", arg, VARCHAR, fc.distinct, sep=sep))
+                aggs.append(AggCall("listagg", arg, VARCHAR, fc.distinct, sep=sep,
+                                    order_keys=self._agg_order(fc, t)))
                 continue
             if name == "every":
                 name = "bool_and"
@@ -1799,6 +1821,11 @@ class _Translator:
         # grouped: bare columns must resolve through the agg_map (GROUP BY
         # context).  A window substitution map alone does not imply grouping.
         self.grouped = grouped if grouped is not None else (agg_map is not None)
+        # MATCH_RECOGNIZE DEFINE context: pattern navigation (PREV/NEXT)
+        # resolves as Call nodes that _plan_match_recognize lowers into
+        # partition-aware shifted columns (reference: pattern navigation in
+        # sql/analyzer/PatternRecognitionAnalyzer.java)
+        self.pattern_nav = False
 
     def translate(self, e: A.Expr) -> IrExpr:
         if self.agg_map is not None and e in self.agg_map:
@@ -2066,6 +2093,19 @@ class _Translator:
 
     def _func(self, e: A.FuncCall) -> IrExpr:
         name = e.name
+        if e.order_by:
+            # only collection aggregates take ORDER BY (checked there);
+            # silently dropping it on a scalar call would mask user mistakes
+            raise PlanningError(f"ORDER BY not allowed in a call to {name}")
+        if name in ("prev", "next"):
+            if not self.pattern_nav:
+                raise PlanningError(
+                    f"{name.upper()}() is only allowed in MATCH_RECOGNIZE DEFINE"
+                )
+            args = tuple(self.translate(a) for a in e.args)
+            if not 1 <= len(args) <= 2:
+                raise PlanningError(f"{name.upper()} takes 1 or 2 arguments")
+            return Call(name, args, args[0].type)
         if name in _AGG_FNS:
             raise PlanningError(f"aggregate {name} in non-aggregate context")
         if name in self._HOF_FNS:

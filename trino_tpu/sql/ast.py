@@ -103,6 +103,10 @@ class FuncCall(Expr):
     name: str  # lowercase
     args: tuple[Expr, ...]
     distinct: bool = False  # count(distinct x)
+    # ordered aggregates: array_agg(x ORDER BY y) / listagg(...) WITHIN
+    # GROUP (ORDER BY y) (reference grammar: aggregation ORDER BY in
+    # SqlBase.g4; docs/functions/aggregate.md ordering-sensitive aggs)
+    order_by: tuple["SortItem", ...] = ()
 
 
 @dataclass(frozen=True)

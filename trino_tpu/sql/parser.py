@@ -868,8 +868,21 @@ class _Parser:
                         args.append(self.parse_expr())
                         while self.accept_op(","):
                             args.append(self.parse_expr())
+                    call_order: tuple = ()
+                    if args and self.accept_kw("ORDER"):
+                        # ordered aggregate: array_agg(x ORDER BY y [desc])
+                        self.expect_kw("BY")
+                        call_order = tuple(self._parse_sort_items())
                     self.expect_op(")")
-                    fc = FuncCall(name, tuple(args), distinct)
+                    if self.accept_kw("WITHIN"):
+                        # listagg(...) WITHIN GROUP (ORDER BY ...)
+                        self.expect_kw("GROUP")
+                        self.expect_op("(")
+                        self.expect_kw("ORDER")
+                        self.expect_kw("BY")
+                        call_order = tuple(self._parse_sort_items())
+                        self.expect_op(")")
+                    fc = FuncCall(name, tuple(args), distinct, call_order)
                 if self.peek_kw("OVER"):
                     return self.parse_over(fc)
                 return fc
@@ -882,6 +895,10 @@ class _Parser:
     def parse_over(self, fc: FuncCall) -> Expr:
         from .ast import WindowFunc
 
+        if fc.order_by:
+            raise SqlSyntaxError(
+                "ORDER BY inside an aggregate is not supported with OVER"
+            )
         self.expect_kw("OVER")
         self.expect_op("(")
         partition_by: list[Expr] = []
