@@ -1,0 +1,129 @@
+"""decimal(p > 18) with real two-limb int64 arithmetic (data/dec128.py —
+the Int128Math analogue, core/trino-spi/.../type/Int128Math.java:1).
+
+Columns whose values exceed the int64 lane carry a second (high-limb) lane;
++/−/negate/abs/compare and SUM are exact at full 128-bit width.  Expected
+values are computed with python's unbounded ints — the sqlite oracle cannot
+hold beyond-int64 integers, so these are differential against exact host
+arithmetic over the same rows.
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+
+BIG = [2**70, -(2**70) + 7, 2**63, -(2**63) - 1, 12345, -1, 10**24, 0]
+
+
+@pytest.fixture(scope="module")
+def d128_engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT, DecimalType
+    from trino_tpu.runtime.engine import Engine
+
+    conn = MemoryConnector()
+    conn.create_table(
+        "big",
+        [
+            ColumnSchema("k", BIGINT),
+            ColumnSchema("x", DecimalType(38, 0)),
+            ColumnSchema("y", DecimalType(38, 0)),
+        ],
+    )
+    x = np.empty(len(BIG), dtype=object)
+    x[:] = BIG
+    y = np.empty(len(BIG), dtype=object)
+    y[:] = [v + 1 for v in BIG]
+    k = np.asarray([i % 2 for i in range(len(BIG))], dtype=np.int64)
+    conn.insert("big", {"k": k, "x": x, "y": y})
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", conn)
+    return eng
+
+
+def test_ingest_and_roundtrip(d128_engine):
+    rows = d128_engine.query("select x from big")
+    got = sorted(int(r[0]) for r in rows)
+    assert got == sorted(BIG)
+
+
+def test_add_sub_neg(d128_engine):
+    rows = d128_engine.query("select x + y, x - y, -x from big")
+    for (s, d, m), v in zip(rows, BIG):
+        assert int(s) == v + (v + 1)
+        assert int(d) == -1
+        assert int(m) == -v
+
+
+def test_compare(d128_engine):
+    rows = d128_engine.query("select count(*) from big where x < y")
+    assert rows == [(len(BIG),)]
+    rows = d128_engine.query("select x from big where x > 9223372036854775807")
+    assert sorted(int(r[0]) for r in rows) == sorted(
+        v for v in BIG if v > 2**63 - 1
+    )
+
+
+def test_sum_exact_beyond_int64(d128_engine):
+    rows = d128_engine.query("select sum(x) from big")
+    assert int(rows[0][0]) == sum(BIG)
+
+
+def test_grouped_sum(d128_engine):
+    rows = d128_engine.query("select k, sum(x) from big group by k order by k")
+    exp = {0: sum(v for i, v in enumerate(BIG) if i % 2 == 0),
+           1: sum(v for i, v in enumerate(BIG) if i % 2 == 1)}
+    assert {r[0]: int(r[1]) for r in rows} == exp
+
+
+def test_count_over_limbed(d128_engine):
+    assert d128_engine.query("select count(x) from big") == [(len(BIG),)]
+
+
+def test_cast_to_double(d128_engine):
+    rows = d128_engine.query("select cast(x as double) from big")
+    for (got,), v in zip(rows, BIG):
+        assert got == pytest.approx(float(v), rel=1e-15)
+
+
+def test_filter_then_sum(d128_engine):
+    rows = d128_engine.query("select sum(x) from big where x > 0")
+    assert int(rows[0][0]) == sum(v for v in BIG if v > 0)
+
+
+def test_scaled_decimal128(d128_engine):
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import DecimalType
+
+    conn = d128_engine.catalogs.get("mem")
+    vals = [10**30 + 25, -(10**30) - 75]  # decimal(38,2): x / 100
+    v = np.empty(2, dtype=object)
+    v[:] = vals
+    conn.create_table("money", [ColumnSchema("amt", DecimalType(38, 2))])
+    conn.insert("money", {"amt": v})
+    rows = d128_engine.query("select sum(amt) from money")
+    assert rows[0][0] == Decimal(sum(vals)).scaleb(-2)
+
+
+def test_small_values_stay_single_lane():
+    """decimal(38) columns whose values fit int64 keep the single-lane fast
+    path (no second limb allocated)."""
+    from trino_tpu.data.page import Column
+    from trino_tpu.data.types import DecimalType
+
+    v = np.empty(3, dtype=object)
+    v[:] = [1, -2, 3]
+    col = Column.from_numpy(DecimalType(38, 0), v)
+    assert col.data2 is None
+    big = np.empty(1, dtype=object)
+    big[:] = [2**100]
+    col = Column.from_numpy(DecimalType(38, 0), big)
+    assert col.data2 is not None
+
+
+def test_unsupported_ops_refuse_loudly(d128_engine):
+    with pytest.raises(NotImplementedError):
+        d128_engine.query("select x from big order by x")

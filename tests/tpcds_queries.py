@@ -2508,3 +2508,357 @@ order by reason_prefix, avg_qty, avg_cash, avg_fee
 limit 100
 """
 ORDERED["q85"] = True
+
+QUERIES["q87"] = """
+select count(*) as num_cool
+from (select distinct c_last_name, c_first_name, d_date
+      from store_sales, date_dim, customer
+      where ss_sold_date_sk = d_date_sk
+        and ss_customer_sk = c_customer_sk
+        and d_month_seq between 96 and 107
+      except
+      select distinct c_last_name, c_first_name, d_date
+      from catalog_sales, date_dim, customer
+      where cs_sold_date_sk = d_date_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and d_month_seq between 96 and 107
+      except
+      select distinct c_last_name, c_first_name, d_date
+      from web_sales, date_dim, customer
+      where ws_sold_date_sk = d_date_sk
+        and ws_bill_customer_sk = c_customer_sk
+        and d_month_seq between 96 and 107) cool_cust
+"""
+ORDERED["q87"] = True
+
+QUERIES["q88"] = """
+select * from
+ (select count(*) h8_30_to_9
+  from store_sales, household_demographics, time_dim, store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 8 and t_minute >= 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+      or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+      or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s1,
+ (select count(*) h9_to_9_30
+  from store_sales, household_demographics, time_dim, store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 9 and t_minute < 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+      or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+      or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s2,
+ (select count(*) h9_30_to_10
+  from store_sales, household_demographics, time_dim, store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 9 and t_minute >= 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+      or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+      or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s3,
+ (select count(*) h10_to_10_30
+  from store_sales, household_demographics, time_dim, store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 10 and t_minute < 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+      or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+      or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s4
+"""
+ORDERED["q88"] = True
+
+QUERIES["q89"] = """
+select * from (
+  select i_category, i_class, i_brand, s_store_name, s_company_name, d_moy,
+         sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_category, i_brand,
+                                        s_store_name, s_company_name)
+           avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk and d_year in (2000)
+    and ((i_category in ('Books', 'Electronics', 'Sports')
+          and i_class in ('fiction', 'classical', 'pop'))
+      or (i_category in ('Men', 'Jewelry', 'Women')
+          and i_class in ('shirts', 'pants', 'blazers')))
+  group by i_category, i_class, i_brand, s_store_name, s_company_name, d_moy
+) tmp1
+where case when (avg_monthly_sales <> 0)
+           then (abs(sum_sales - avg_monthly_sales) / avg_monthly_sales)
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name, sum_sales
+limit 100
+"""
+ORDERED["q89"] = False  # ties in the sort prefix
+
+QUERIES["q72"] = """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+       sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+       count(*) total_cnt
+from catalog_sales
+join inventory on (cs_item_sk = inv_item_sk)
+join warehouse on (w_warehouse_sk = inv_warehouse_sk)
+join item on (i_item_sk = cs_item_sk)
+join customer_demographics on (cs_bill_cdemo_sk = cd_demo_sk)
+join household_demographics on (cs_bill_hdemo_sk = hd_demo_sk)
+join date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)
+join date_dim d2 on (inv_date_sk = d2.d_date_sk)
+join date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)
+left outer join promotion on (cs_promo_sk = p_promo_sk)
+left outer join catalog_returns on (cr_item_sk = cs_item_sk
+                                    and cr_order_number = cs_order_number)
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and d3.d_date_sk > d1.d_date_sk + 5
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 2000
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
+limit 100
+"""
+ORDERED["q72"] = True
+
+QUERIES["q77"] = """
+with ss as
+ (select s_store_sk, sum(ss_ext_sales_price) as sales,
+         sum(ss_net_profit) as profit
+  from store_sales, date_dim, store
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and date '2000-08-03' + interval '30' day
+    and ss_store_sk = s_store_sk
+  group by s_store_sk),
+ sr as
+ (select s_store_sk, sum(sr_return_amt) as returns_amt,
+         sum(sr_net_loss) as profit_loss
+  from store_returns, date_dim, store
+  where sr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and date '2000-08-03' + interval '30' day
+    and sr_store_sk = s_store_sk
+  group by s_store_sk),
+ cs as
+ (select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+         sum(cs_net_profit) as profit
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and date '2000-08-03' + interval '30' day
+  group by cs_call_center_sk),
+ cr as
+ (select cr_call_center_sk, sum(cr_return_amount) as returns_amt,
+         sum(cr_net_loss) as profit_loss
+  from catalog_returns, date_dim
+  where cr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and date '2000-08-03' + interval '30' day
+  group by cr_call_center_sk),
+ ws as
+ (select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
+         sum(ws_net_profit) as profit
+  from web_sales, date_dim, web_page
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and date '2000-08-03' + interval '30' day
+    and ws_web_page_sk = wp_web_page_sk
+  group by wp_web_page_sk),
+ wr as
+ (select wp_web_page_sk, sum(wr_return_amt) as returns_amt,
+         sum(wr_net_loss) as profit_loss
+  from web_returns, date_dim, web_page
+  where wr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and date '2000-08-03' + interval '30' day
+    and wr_web_page_sk = wp_web_page_sk
+  group by wp_web_page_sk)
+select channel, id, sum(sales) as sales, sum(returns_amt) as returns_amt,
+       sum(profit) as profit
+from (select 'store channel' as channel, ss.s_store_sk as id, sales,
+             coalesce(returns_amt, 0) returns_amt,
+             (profit - coalesce(profit_loss, 0)) profit
+      from ss left join sr on ss.s_store_sk = sr.s_store_sk
+      union all
+      select 'catalog channel' as channel, cs_call_center_sk as id, sales,
+             returns_amt, (profit - profit_loss) profit
+      from cs, cr
+      union all
+      select 'web channel' as channel, ws.wp_web_page_sk as id, sales,
+             coalesce(returns_amt, 0) returns_amt,
+             (profit - coalesce(profit_loss, 0)) profit
+      from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+"""
+ORDERED["q77"] = True
+
+QUERIES["q75"] = """
+with all_sales as (
+ select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+        sum(sales_cnt) as sales_cnt, sum(sales_amt) as sales_amt
+ from (select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+              cs_quantity - coalesce(cr_return_quantity, 0) as sales_cnt,
+              cs_ext_sales_price - coalesce(cr_return_amount, 0.0) as sales_amt
+       from catalog_sales
+       join item on i_item_sk = cs_item_sk
+       join date_dim on d_date_sk = cs_sold_date_sk
+       left join catalog_returns on (cs_order_number = cr_order_number
+                                     and cs_item_sk = cr_item_sk)
+       where i_category = 'Books'
+       union
+       select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+              ss_quantity - coalesce(sr_return_quantity, 0) as sales_cnt,
+              ss_ext_sales_price - coalesce(sr_return_amt, 0.0) as sales_amt
+       from store_sales
+       join item on i_item_sk = ss_item_sk
+       join date_dim on d_date_sk = ss_sold_date_sk
+       left join store_returns on (ss_ticket_number = sr_ticket_number
+                                   and ss_item_sk = sr_item_sk)
+       where i_category = 'Books'
+       union
+       select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+              ws_quantity - coalesce(wr_return_quantity, 0) as sales_cnt,
+              ws_ext_sales_price - coalesce(wr_return_amt, 0.0) as sales_amt
+       from web_sales
+       join item on i_item_sk = ws_item_sk
+       join date_dim on d_date_sk = ws_sold_date_sk
+       left join web_returns on (ws_order_number = wr_order_number
+                                 and ws_item_sk = wr_item_sk)
+       where i_category = 'Books') sales_detail
+ group by d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+select prev_yr.d_year as prev_year, curr_yr.d_year as year_,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id, prev_yr.sales_cnt as prev_yr_cnt,
+       curr_yr.sales_cnt as curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt as sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt as sales_amt_diff
+from all_sales curr_yr, all_sales prev_yr
+where curr_yr.i_brand_id = prev_yr.i_brand_id
+  and curr_yr.i_class_id = prev_yr.i_class_id
+  and curr_yr.i_category_id = prev_yr.i_category_id
+  and curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  and curr_yr.d_year = 2001 and prev_yr.d_year = 2000
+  and cast(curr_yr.sales_cnt as double) / cast(prev_yr.sales_cnt as double) < 0.9
+order by sales_cnt_diff, sales_amt_diff
+limit 100
+"""
+ORDERED["q75"] = True
+
+QUERIES["q78"] = """
+with ws as
+ (select d_year as ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk ws_customer_sk,
+         sum(ws_quantity) ws_qty, sum(ws_wholesale_cost) ws_wc,
+         sum(ws_sales_price) ws_sp
+  from web_sales
+  left join web_returns on wr_order_number = ws_order_number
+                        and ws_item_sk = wr_item_sk
+  join date_dim on ws_sold_date_sk = d_date_sk
+  where wr_order_number is null
+  group by d_year, ws_item_sk, ws_bill_customer_sk),
+ cs as
+ (select d_year as cs_sold_year, cs_item_sk,
+         cs_bill_customer_sk cs_customer_sk,
+         sum(cs_quantity) cs_qty, sum(cs_wholesale_cost) cs_wc,
+         sum(cs_sales_price) cs_sp
+  from catalog_sales
+  left join catalog_returns on cr_order_number = cs_order_number
+                            and cs_item_sk = cr_item_sk
+  join date_dim on cs_sold_date_sk = d_date_sk
+  where cr_order_number is null
+  group by d_year, cs_item_sk, cs_bill_customer_sk),
+ ss as
+ (select d_year as ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         sum(ss_quantity) ss_qty, sum(ss_wholesale_cost) ss_wc,
+         sum(ss_sales_price) ss_sp
+  from store_sales
+  left join store_returns on sr_ticket_number = ss_ticket_number
+                          and ss_item_sk = sr_item_sk
+  join date_dim on ss_sold_date_sk = d_date_sk
+  where sr_ticket_number is null
+  group by d_year, ss_item_sk, ss_customer_sk)
+select ss_item_sk,
+       round(ss_qty / (coalesce(ws_qty, 0) + coalesce(cs_qty, 0) + 0.0001), 2)
+         ratio,
+       ss_qty store_qty, ss_wc store_wholesale_cost, ss_sp store_sales_price,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0) other_chan_qty,
+       coalesce(ws_wc, 0) + coalesce(cs_wc, 0) other_chan_wholesale_cost,
+       coalesce(ws_sp, 0) + coalesce(cs_sp, 0) other_chan_sales_price
+from ss
+left join ws on (ws_sold_year = ss_sold_year and ws_item_sk = ss_item_sk
+                 and ws_customer_sk = ss_customer_sk)
+left join cs on (cs_sold_year = ss_sold_year and cs_item_sk = ss_item_sk
+                 and cs_customer_sk = ss_customer_sk)
+where (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)
+  and ss_sold_year = 2000
+order by ss_item_sk, ss_qty desc, ss_wc desc, ss_sp desc, other_chan_qty,
+         other_chan_wholesale_cost, other_chan_sales_price, ratio
+limit 100
+"""
+ORDERED["q78"] = True
+
+QUERIES["q23"] = """
+with frequent_ss_items as
+ (select substring(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+         d_date solddate, count(*) cnt
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and d_year in (2000, 2001, 2002, 2003)
+  group by substring(i_item_desc, 1, 30), i_item_sk, d_date
+  having count(*) > 4),
+ max_store_sales as
+ (select max(csales) tpcds_cmax
+  from (select c_customer_sk, sum(ss_quantity * ss_sales_price) csales
+        from store_sales, customer, date_dim
+        where ss_customer_sk = c_customer_sk and ss_sold_date_sk = d_date_sk
+          and d_year in (2000, 2001, 2002, 2003)
+        group by c_customer_sk) x),
+ best_ss_customer as
+ (select c_customer_sk, sum(ss_quantity * ss_sales_price) ssales
+  from store_sales, customer
+  where ss_customer_sk = c_customer_sk
+  group by c_customer_sk
+  having sum(ss_quantity * ss_sales_price)
+       > 0.5 * (select tpcds_cmax from max_store_sales))
+select sum(sales) as total_sales
+from (select cs_quantity * cs_list_price sales
+      from catalog_sales, date_dim
+      where d_year = 2000 and d_moy = 2 and cs_sold_date_sk = d_date_sk
+        and cs_item_sk in (select item_sk from frequent_ss_items)
+        and cs_bill_customer_sk in (select c_customer_sk from best_ss_customer)
+      union all
+      select ws_quantity * ws_list_price sales
+      from web_sales, date_dim
+      where d_year = 2000 and d_moy = 2 and ws_sold_date_sk = d_date_sk
+        and ws_item_sk in (select item_sk from frequent_ss_items)
+        and ws_bill_customer_sk in (select c_customer_sk
+                                    from best_ss_customer)) y
+"""
+ORDERED["q23"] = True
+
+QUERIES["q24"] = """
+with ssales as
+ (select c_last_name, c_first_name, s_store_name, ca_state, s_state,
+         i_color, i_current_price, i_manager_id, i_units, i_size,
+         sum(ss_net_paid) netpaid
+  from store_sales, store_returns, store, item, customer, customer_address
+  where ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk
+    and ss_customer_sk = c_customer_sk and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk and c_current_addr_sk = ca_address_sk
+    and c_birth_country <> ca_country and s_zip = ca_zip
+    and s_market_id = 6
+  group by c_last_name, c_first_name, s_store_name, ca_state, s_state,
+           i_color, i_current_price, i_manager_id, i_units, i_size)
+select c_last_name, c_first_name, s_store_name, sum(netpaid) paid
+from ssales
+where i_color = 'red'
+group by c_last_name, c_first_name, s_store_name
+having sum(netpaid) > (select 0.05 * avg(netpaid) from ssales)
+order by c_last_name, c_first_name, s_store_name, paid
+limit 100
+"""
+ORDERED["q24"] = True

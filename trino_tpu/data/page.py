@@ -152,22 +152,25 @@ class Dictionary:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Column:
-    """One column of a page: device data + optional validity + optional dict."""
+    """One column of a page: device data + optional validity + optional dict.
+    data2: decimal128 high limb (data/dec128.py) — value = data2*2^64 +
+    u64(data); None everywhere else."""
 
     type: Type
     data: jnp.ndarray
     valid: Optional[jnp.ndarray] = None  # bool mask; None == all valid
     dictionary: Optional[Dictionary] = None
+    data2: Optional[jnp.ndarray] = None
 
     def tree_flatten(self):
-        children = (self.data, self.valid)
+        children = (self.data, self.valid, self.data2)
         return children, (self.type, self.dictionary)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        data, valid = children
+        data, valid, data2 = children
         type_, dictionary = aux
-        return cls(type_, data, valid, dictionary)
+        return cls(type_, data, valid, dictionary, data2)
 
     @property
     def capacity(self) -> int:
@@ -194,6 +197,27 @@ class Column:
         if type_.is_string:
             codes, dictionary = Dictionary.encode(values)
             return Column(type_, jnp.asarray(codes), None if valid is None else jnp.asarray(valid), dictionary)
+        if (
+            type_.is_decimal
+            and type_.precision > 18
+            and np.asarray(values).dtype == object
+        ):
+            # object lanes can hold beyond-int64 magnitudes; numeric-dtype
+            # inputs by construction already fit the single lane
+            vo = np.asarray(values, dtype=object)
+            from .dec128 import needs_limbs, to_limbs
+
+            flat = [None if (valid is not None and not valid[i]) else vo[i]
+                    for i in range(len(vo))]
+            if needs_limbs(flat):
+                lo, hi = to_limbs(flat)
+                return Column(
+                    type_, jnp.asarray(lo),
+                    None if valid is None else jnp.asarray(valid),
+                    None, jnp.asarray(hi),
+                )
+            values = np.asarray([0 if v is None else int(v) for v in flat],
+                                dtype=np.int64)
         arr = np.asarray(values, dtype=type_.np_dtype)
         if arr.dtype == np.int64 and arr.size:
             # Lane narrowing: TPUs have no native int64 (every 64-bit
@@ -260,7 +284,7 @@ class Page:
         import jax
 
         everything = jax.device_get(
-            [self.live_mask()] + [(c.data, c.valid) for c in self.columns]
+            [self.live_mask()] + [(c.data, c.valid, c.data2) for c in self.columns]
         )
         return np.asarray(everything[0]), everything[1:]
 
@@ -272,9 +296,10 @@ class Page:
         cols: list[np.ndarray] = []
         valids: list[Optional[np.ndarray]] = []
         pys: list[Any] = []
-        for col, (hdata, hvalid) in zip(self.columns, host_cols):
+        for col, (hdata, hvalid, hdata2) in zip(self.columns, host_cols):
             data = np.asarray(hdata)[idx]
             valid = None if hvalid is None else np.asarray(hvalid)[idx]
+            data2 = None if hdata2 is None else np.asarray(hdata2)[idx]
             if col.type.is_map:
                 vals = (
                     col.dictionary.values[np.clip(data, 0, max(len(col.dictionary) - 1, 0))]
@@ -312,9 +337,24 @@ class Page:
             elif col.type.is_floating:
                 pys.append(data.astype(float))
             elif col.type.is_decimal:
-                # scaled int64 -> float (result-set surface; int64/10^s is
-                # exact in f64 for short decimals)
-                pys.append(data.astype(np.int64) / (10.0 ** col.type.scale))
+                if data2 is not None:
+                    # limbed decimal128: exact python Decimal surface
+                    from decimal import Decimal
+
+                    from .dec128 import combine_py
+
+                    vals = np.empty(len(data), dtype=object)
+                    for i in range(len(data)):
+                        unscaled = combine_py(int(data2[i]), int(data[i]))
+                        vals[i] = (
+                            Decimal(unscaled).scaleb(-col.type.scale)
+                            if col.type.scale else Decimal(unscaled)
+                        )
+                    pys.append(vals)
+                else:
+                    # scaled int64 -> float (result-set surface; int64/10^s
+                    # is exact in f64 for short decimals)
+                    pys.append(data.astype(np.int64) / (10.0 ** col.type.scale))
             else:
                 pys.append(data)
             valids.append(valid)

@@ -585,6 +585,14 @@ def _trace_plan(
             node_hook(nid_here, node, stage)
         return stage
 
+    def check_limbed(stage: _Stage, what: str) -> _Stage:
+        # v1 decimal128 surface: scan -> filter/project -> aggregate.  Ops
+        # that re-gather columns would silently drop the high limb, so they
+        # refuse loudly instead (Int128 paths widen per-operator over time)
+        if any(cv.data2 is not None for cv in stage.cols):
+            raise NotImplementedError(f"decimal128 columns through {what}")
+        return stage
+
     def _emit(node: PlanNode) -> _Stage:
         nid = counter[0]
         counter[0] += 1
@@ -643,12 +651,15 @@ def _trace_plan(
             for (data, valid), kv in zip(out_keys, keys):
                 cols.append(ColumnVal(data, _none_if_all(valid), kv.dict, kv.type))
             for out, a, arg in zip(out_aggs, node.aggs, args):
-                if len(out) == 3:  # host-collected: carries its own dictionary
+                hi = None
+                if len(out) == 4:  # decimal128 sum: (lo, valid, None, hi)
+                    data, valid, d, hi = out
+                elif len(out) == 3:  # host-collected: carries its own dictionary
                     data, valid, d = out
                 else:
                     data, valid = out
                     d = arg.dict if (arg is not None and a.fn in ("min", "max")) else None
-                cols.append(ColumnVal(data, valid, d, a.type))
+                cols.append(ColumnVal(data, valid, d, a.type, data2=hi))
             return _Stage(cols, out_live)
 
         if isinstance(node, Distinct):
@@ -665,8 +676,8 @@ def _trace_plan(
             return _Stage(cols, out_live)
 
         if isinstance(node, Join):
-            left = emit(node.left)
-            right = emit(node.right)
+            left = check_limbed(emit(node.left), "join")
+            right = check_limbed(emit(node.right), "join")
             if node.kind == "cross":
                 cols, live = broadcast_single_row(
                     left.cols, left.live, right.cols, right.live
@@ -691,7 +702,7 @@ def _trace_plan(
             return _Stage(cols, live)
 
         if isinstance(node, Unnest):
-            s = emit(node.child)
+            s = check_limbed(emit(node.child), "unnest")
             C = caps[nid]
             arrays = [eval_expr(a, s.cols, s.capacity) for a in node.arrays]
             cols, live, req = unnest_expand(
@@ -702,14 +713,14 @@ def _trace_plan(
             return _Stage(cols, live)
 
         if isinstance(node, Sort):
-            s = emit(node.child)
+            s = emit(node.child)  # limbed payloads ride sort_rows' gathers
             keys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.keys]
             specs = [SortSpec(k.ascending, k.nulls_first) for k in node.keys]
             cols, live = sort_rows(s.cols, s.live, keys, specs)
             return _Stage(cols, live)
 
         if isinstance(node, TopN):
-            s = emit(node.child)
+            s = emit(node.child)  # limbed payloads ride the gathers
             keys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.keys]
             specs = [SortSpec(k.ascending, k.nulls_first) for k in node.keys]
             cols, live, req = top_n(
@@ -723,7 +734,7 @@ def _trace_plan(
             return _Stage(s.cols, limit_mask(s.live, node.count))
 
         if isinstance(node, Concat):
-            stages = [emit(c) for c in node.inputs]
+            stages = [check_limbed(emit(c), "union") for c in node.inputs]
             cols: list[ColumnVal] = []
             for ci, t in enumerate(node.output_types):
                 parts = [st.cols[ci] for st in stages]
@@ -734,7 +745,7 @@ def _trace_plan(
         if isinstance(node, Window):
             from ..ops.window import window_eval
 
-            s = emit(node.child)
+            s = check_limbed(emit(node.child), "window")
             part = [eval_expr(k, s.cols, s.capacity) for k in node.partition_by]
             okeys = [eval_expr(k.expr, s.cols, s.capacity) for k in node.order_by]
             ospecs = [SortSpec(k.ascending, k.nulls_first) for k in node.order_by]
@@ -748,7 +759,7 @@ def _trace_plan(
             return _Stage(cols, live)
 
         if isinstance(node, Exchange):
-            s = emit(node.child)
+            s = check_limbed(emit(node.child), "exchange")
             if node.kind == "single":
                 # replicated input that must count once: keep device 0's copy
                 if axis is not None:
@@ -797,7 +808,7 @@ def _trace_plan(
     stage = emit(plan)
     out_page = Page(
         tuple(
-            Column(cv.type, cv.data, cv.valid, cv.dict)
+            Column(cv.type, cv.data, cv.valid, cv.dict, cv.data2)
             for cv in stage.cols
         ),
         stage.live,

@@ -72,7 +72,14 @@ class SpmdExecutor(LocalExecutor):
                 v = np.zeros((total,), dtype=np.bool_)
                 v[:n] = np.asarray(col.valid)
                 valid = jnp.asarray(v)
-            cols.append(Column(col.type, jnp.asarray(data), valid, col.dictionary))
+            data2 = None
+            if col.data2 is not None:
+                d2 = np.zeros((total,), dtype=np.asarray(col.data2).dtype)
+                d2[:n] = np.asarray(col.data2)
+                data2 = jnp.asarray(d2)
+            cols.append(
+                Column(col.type, jnp.asarray(data), valid, col.dictionary, data2)
+            )
         live = np.zeros((total,), dtype=np.bool_)
         live[:n] = True
         return Page(tuple(cols), jnp.asarray(live))

@@ -43,14 +43,18 @@ class ColumnVal:
     valid: Optional[jnp.ndarray]
     dict: Optional[Dictionary] = None
     type: Optional[Type] = None
+    # decimal128 high limb (data/dec128.py): value = data2*2^64 + u64(data).
+    # None for every non-limbed column; ops that cannot carry the second
+    # lane (sorts, joins, exchanges) raise rather than silently truncate.
+    data2: Optional[jnp.ndarray] = None
 
 
 def column_val(col: Column) -> ColumnVal:
-    return ColumnVal(col.data, col.valid, col.dictionary, col.type)
+    return ColumnVal(col.data, col.valid, col.dictionary, col.type, col.data2)
 
 
 def to_column(v: ColumnVal, type_: Type) -> Column:
-    return Column(type_, v.data, v.valid, v.dict)
+    return Column(type_, v.data, v.valid, v.dict, v.data2)
 
 
 def _and_valid(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
@@ -162,6 +166,8 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         return ColumnVal(data, None, None, BOOLEAN)
     if op == "coalesce":
         vals = [eval_expr(a, cols, n) for a in e.args]
+        if any(v.data2 is not None for v in vals):
+            raise NotImplementedError("decimal128 through coalesce")
         out = vals[-1]
         for v in reversed(vals[:-1]):
             if v.valid is None:
@@ -217,6 +223,12 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
     for v in args:
         valid = _and_valid(valid, v.valid)
 
+    if (
+        op in ("neg", "abs", "eq", "ne", "lt", "le", "gt", "ge", "add", "sub",
+               "mul", "div", "mod")
+        and any(v.data2 is not None for v in args)
+    ):
+        return _limbed_op(op, args, valid, e)
     if op == "neg":
         return ColumnVal(-args[0].data, valid, None, e.type)
     if op in ("eq", "ne", "lt", "le", "gt", "ge"):
@@ -1290,6 +1302,26 @@ def _cast(a: ColumnVal, target: Type, n: int) -> ColumnVal:
         raise NotImplementedError("cast to varchar")
     # DECIMAL rescaling on int64 lanes (reference: spi/type/DecimalConversions
     # — rescale by powers of ten, round half away from zero when narrowing)
+    if a.data2 is not None:
+        if target.is_decimal and target.scale == (
+            a.type.scale if a.type is not None else 0
+        ):
+            # precision widening at the same scale: lanes unchanged
+            return ColumnVal(a.data, a.valid, None, target, data2=a.data2)
+        if target.is_floating:
+            # limbed decimal128 -> double.  v = lo_signed + 2^64*(hi + [lo<0])
+            # — the signed-lo form avoids the catastrophic cancellation of
+            # hi*2^64 + u64(lo) for small negatives (u64(-1) rounds to 2^64
+            # in f64, summing to 0.0 instead of -1.0)
+            lo = a.data.astype(jnp.int64)
+            src_scale = a.type.scale if a.type is not None else 0
+            hi_adj = a.data2 + jnp.where(lo < 0, 1, 0).astype(a.data2.dtype)
+            out = (
+                lo.astype(jnp.float64)
+                + hi_adj.astype(jnp.float64) * float(2**64)
+            ) / (10.0**src_scale)
+            return ColumnVal(out.astype(_np_to_jnp(target)), a.valid, None, target)
+        raise NotImplementedError(f"cast decimal128 to {target.name}")
     if target.is_decimal or (a.type is not None and a.type.is_decimal):
         src_scale = a.type.scale if (a.type is not None and a.type.is_decimal) else 0
         if target.is_decimal:
@@ -1358,6 +1390,8 @@ def _kleene(op: str, e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
 def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
     if e.default is not None:
         out = eval_expr(e.default, cols, n)
+        if out.data2 is not None:
+            raise NotImplementedError("decimal128 through CASE")
     else:
         out = ColumnVal(
             jnp.zeros((n,), dtype=_np_to_jnp(e.type)),
@@ -1368,6 +1402,8 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
     evaluated = [
         (eval_expr(cond, cols, n), eval_expr(res, cols, n)) for cond, res in e.whens
     ]
+    if any(r.data2 is not None for _, r in evaluated):
+        raise NotImplementedError("decimal128 through CASE")
     if out.dict is not None or any(r.dict is not None for _, r in evaluated):
         # varchar CASE: union the branch dictionaries on the host, remap each
         # branch's codes into union space, select codes on device — the same
@@ -1416,6 +1452,51 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
             rvm = rv if rv is not None else jnp.ones((n,), jnp.bool_)
             out_valid = jnp.where(cm, rvm, ov)
     return ColumnVal(out_data, out_valid, result_dict, e.type)
+
+
+def _as_limbs(v: ColumnVal):
+    """(lo, hi) int64 pair; single-lane numeric operands sign-extend."""
+    lo = v.data.astype(jnp.int64)
+    if v.data2 is not None:
+        return lo, v.data2.astype(jnp.int64)
+    return lo, lo >> 63  # arithmetic shift: 0 for >=0, -1 for <0
+
+
+def _limbed_op(op: str, args, valid, e) -> ColumnVal:
+    """decimal128 elementwise ops over two-limb lanes (reference:
+    spi/type/Int128Math.java add/subtract/compare).  Operands were already
+    scale-aligned by the planner, like the single-lane decimal path."""
+    from ..data import dec128 as d
+
+    if op in ("mul", "div", "mod"):
+        raise NotImplementedError(
+            f"decimal128 {op} (128-bit multiply/divide lanes)"
+        )
+    alo, ahi = _as_limbs(args[0])
+    if op == "neg":
+        lo, hi = d.neg128(alo, ahi)
+        return ColumnVal(lo, valid, None, e.type, data2=hi)
+    if op == "abs":
+        lo, hi = d.neg128(alo, ahi)
+        neg = ahi < 0
+        return ColumnVal(
+            jnp.where(neg, lo, alo), valid, None, e.type,
+            data2=jnp.where(neg, hi, ahi),
+        )
+    blo, bhi = _as_limbs(args[1])
+    if op in ("add", "sub"):
+        lo, hi = (
+            d.add128(alo, ahi, blo, bhi)
+            if op == "add"
+            else d.sub128(alo, ahi, blo, bhi)
+        )
+        return ColumnVal(lo, valid, None, e.type, data2=hi)
+    lt, eq = d.cmp128(alo, ahi, blo, bhi)
+    out = {
+        "eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+        "gt": ~(lt | eq), "ge": ~lt,
+    }[op]
+    return ColumnVal(out, valid, None, BOOLEAN)
 
 
 # ---------------------------------------------------- dictionary (host) ops

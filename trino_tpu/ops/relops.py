@@ -147,6 +147,10 @@ def _chunked_f32_segment_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int):
 def _sortable_key(v: ColumnVal, descending: bool = False) -> jnp.ndarray:
     """Lower a column to a sortable numeric array (varchar -> dictionary rank,
     bool -> int8); negated for descending order."""
+    if v.data2 is not None:
+        raise NotImplementedError(
+            "decimal128 lanes as sort/group/join keys (two-limb keys)"
+        )
     data = v.data
     if v.dict is not None:
         data = jnp.take(jnp.asarray(v.dict.sorted_rank()), v.data)
@@ -370,6 +374,12 @@ def _fused_aggs(
         agg_args2 = [None] * len(specs)
     recipe: list = []
     for arg, arg2, spec in zip(agg_args, agg_args2, specs):
+        if any(
+            v is not None and v.data2 is not None for v in (arg, arg2)
+        ) and not (spec.fn in ("sum", "count") and not spec.distinct):
+            raise NotImplementedError(
+                f"aggregate {spec.fn} over decimal128 lanes (sum/count only)"
+            )
         if (
             spec.distinct
             or spec.fn in ("percentile", "approx_distinct")
@@ -412,6 +422,23 @@ def _fused_aggs(
         valid = valid & live_s
         if spec.fn == "count":
             recipe.append(("count", add_count(valid)))
+        elif arg.data2 is not None and spec.fn == "sum":
+            # decimal128 sum: four 32-bit limb sums (each exact in int64 for
+            # n < 2^31 rows) recombined into two-limb outputs (the segreduce
+            # analogue of Int128Math.addWithOverflow accumulation)
+            from ..data.dec128 import limbs32
+
+            hi = arg.data2 if perm is None else jnp.take(arg.data2, perm)
+            l0, l1, l2, l3 = limbs32(data.astype(jnp.int64), hi)
+            recipe.append(
+                ("sum128", add(SegRed("sum", l0, valid)),
+                 add(SegRed("sum", l1, valid)), add(SegRed("sum", l2, valid)),
+                 add(SegRed("sum", l3, valid)), add_count(valid))
+            )
+        elif arg.data2 is not None:
+            raise NotImplementedError(
+                f"aggregate {spec.fn} over decimal128 lanes (sum/count only)"
+            )
         elif spec.fn in ("sum", "avg"):
             as_int = spec.fn == "sum" and jnp.issubdtype(data.dtype, jnp.integer)
             vals = data if as_int else data.astype(jnp.float64)
@@ -460,6 +487,15 @@ def _fused_aggs(
         kind = r[0]
         if kind == "count":
             out.append((results[r[1]], None))
+        elif kind == "sum128":
+            from ..data.dec128 import recombine32
+
+            s0, s1, s2, s3, cnt = (results[r[i]] for i in range(1, 6))
+            lo, hi = recombine32(
+                s0.astype(jnp.int64), s1.astype(jnp.int64),
+                s2.astype(jnp.int64), s3.astype(jnp.int64),
+            )
+            out.append((lo, cnt > 0, None, hi))
         elif kind in ("sum", "avg"):
             s, cnt = results[r[1]], results[r[2]]
             nonempty = cnt > 0
@@ -1160,6 +1196,7 @@ def sort_rows(
             None if cv.valid is None else jnp.take(cv.valid, perm),
             cv.dict,
             cv.type,
+            None if cv.data2 is None else jnp.take(cv.data2, perm),
         )
         for cv in cols
     ]
@@ -1209,6 +1246,7 @@ def top_n(cols, live, keys, specs, count: int, cap: Optional[int] = None):
                 None if cv.valid is None else jnp.take(cv.valid, idx_buf),
                 cv.dict,
                 cv.type,
+                None if cv.data2 is None else jnp.take(cv.data2, idx_buf),
             )
 
         sub_cols = [gather(cv) for cv in cols]
@@ -1221,6 +1259,7 @@ def top_n(cols, live, keys, specs, count: int, cap: Optional[int] = None):
                 None if cv.valid is None else cv.valid[:k],
                 cv.dict,
                 cv.type,
+                None if cv.data2 is None else cv.data2[:k],
             )
             for cv in sorted_cols
         ]
@@ -1234,6 +1273,7 @@ def top_n(cols, live, keys, specs, count: int, cap: Optional[int] = None):
             None if cv.valid is None else cv.valid[:k],
             cv.dict,
             cv.type,
+            None if cv.data2 is None else cv.data2[:k],
         )
         for cv in sorted_cols
     ]

@@ -2379,11 +2379,14 @@ def _cmp(op: str, a: IrExpr, b: IrExpr) -> IrExpr:
     b = _tighten_int_const(b, a.type)
     tt = common_super_type(a.type, b.type)
     if tt.is_decimal:
-        # rescaling either side to the common scale must stay inside int64:
-        # whole digits + common scale <= 18, else compare as doubles
+        # a RESCALED operand must stay inside int64 lanes (whole digits +
+        # common scale <= 18) — else compare as doubles.  Operands already
+        # at the common scale never rescale: decimal128 lanes compare
+        # exactly via the two-limb path (ops/expr.py _limbed_op)
         for t in (a.type, b.type):
             whole = (t.precision - t.scale) if t.is_decimal else 18
-            if whole + tt.scale > 18:
+            scale = t.scale if t.is_decimal else 0
+            if scale != tt.scale and whole + tt.scale > 18:
                 tt = DOUBLE
                 break
     return Call(op, (_cast_ir(a, tt), _cast_ir(b, tt)), BOOLEAN)
