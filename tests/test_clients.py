@@ -101,3 +101,43 @@ def test_faker_queries(cluster):
         "select count(*) from faker.events where day >= date '2021-01-01'"
     )
     assert 0 < rows[0][0] < 2000
+
+
+def test_spooled_client_protocol(tmp_path):
+    """SPOOLED result protocol (reference: server/protocol/spooling +
+    client/spooling SegmentLoader): with a client spool configured and the
+    client advertising support, results come back via on-disk segment URIs
+    — the response carries no inline data, the coordinator drops the rows
+    from RAM, and the client's segment ack deletes the files."""
+    import glob
+    import json as _json
+    import urllib.request
+
+    from trino_tpu.client.client import StatementClient
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(num_workers=2)
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    try:
+        runner.coordinator.session.set("client_spool_dir", str(tmp_path))
+        sql = "select n_nationkey, n_name from nation order by n_nationkey"
+        plain = StatementClient(runner.coordinator.url).execute(sql)
+        cols, rows = StatementClient(
+            runner.coordinator.url, spooled=True
+        ).execute(sql)
+        assert rows == plain[1]
+        assert len(rows) == 25
+        # inline protocol response for the spooled query had segments only
+        qid = [
+            q for q, rec in runner.coordinator.queries.items()
+            if rec.get("segments") is not None
+        ]
+        assert qid, "no spooled query recorded"
+        rec = runner.coordinator.queries[qid[0]]
+        assert rec["result"] == []  # rows left coordinator RAM
+        # acked segments were deleted from the spool dir
+        assert glob.glob(str(tmp_path / f"{qid[0]}_seg*")) == []
+    finally:
+        runner.stop()

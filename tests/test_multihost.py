@@ -220,3 +220,119 @@ def test_statement_surface_via_coordinator(cluster, oracle):
     desc = cluster.query_via_protocol("describe memory2.t_stmt")
     assert ("l_quantity", "decimal(12,2)") in [tuple(r) for r in desc]
     cluster.query_via_protocol("drop table memory2.t_stmt")
+
+
+def test_phased_schedule_overlaps_independent_subtrees(cluster, oracle):
+    """PHASED mode (retry_policy=TASK) runs independent sibling stages
+    CONCURRENTLY (reference: scheduler/policy/PhasedExecutionSchedule.java
+    — stages whose dependencies are satisfied schedule together): in a
+    UNION ALL of two aggregations over different tables, each branch is an
+    independent subtree, so one branch must START before the other ENDS."""
+    cluster.coordinator.session.set("retry_policy", "TASK")
+    try:
+        sql = """
+          select count(*) as c from lineitem
+          union all
+          select count(*) as c from orders
+        """
+        got = cluster.query(sql)
+        assert_rows_equal(got, oracle.query(sql), ordered=False)
+        times = cluster.coordinator.last_stage_times
+        assert len(times) >= 2, times
+        ivs = sorted(times.values())
+        overlapping = any(
+            a_start < b_end and b_start < a_end
+            for i, (a_start, a_end) in enumerate(ivs)
+            for (b_start, b_end) in ivs[i + 1:]
+        )
+        assert overlapping, f"no overlapping stage intervals: {times}"
+    finally:
+        cluster.coordinator.session.set("retry_policy", "NONE")
+
+
+def test_adaptive_memory_budget_grows_on_retry(cluster, oracle):
+    """FTE adaptive retry (reference: ExponentialGrowthPartitionMemory
+    Estimator): with a task memory budget too small for the plan, the FIRST
+    attempt is refused by the worker executor; the retry re-runs with a 4x
+    budget and succeeds ONLY because the estimate grew."""
+    cluster.coordinator.session.set("retry_policy", "TASK")
+    cluster.coordinator.session.set("task_memory_budget_bytes", 200_000)
+    try:
+        sql = QUERIES["q01"]
+        got = cluster.query(sql)
+        assert_rows_equal(got, oracle.query(sql), ordered=ORDERED["q01"])
+    finally:
+        cluster.coordinator.session.set("task_memory_budget_bytes", 0)
+        cluster.coordinator.session.set("retry_policy", "NONE")
+
+
+def test_memory_budget_refusal_without_retry_fails(cluster):
+    """Same tiny budget under retry_policy=NONE: the refusal surfaces as a
+    query failure (proves the budget is actually enforced — the adaptive
+    test above passes BECAUSE the growth happens, not because the budget
+    is ignored)."""
+    import pytest as _pytest
+
+    cluster.coordinator.session.set("task_memory_budget_bytes", 200_000)
+    try:
+        with _pytest.raises(Exception):
+            cluster.query(QUERIES["q01"])
+    finally:
+        cluster.coordinator.session.set("task_memory_budget_bytes", 0)
+
+
+def test_bucketed_table_skips_repartition(tpch_tiny, oracle):
+    """Connector-bucketed execution (reference: BucketNodeMap +
+    ConnectorNodePartitioningProvider): a memory table bucketed on the
+    group key by the ENGINE's partition hash is born hash-partitioned, so
+    the distributed plan aggregates WITHOUT a repartition exchange — and
+    still agrees with an unbucketed run."""
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.plan.distribute import distribute
+    from trino_tpu.plan.nodes import Exchange, walk
+    from trino_tpu.plan.optimizer import optimize
+    from trino_tpu.testing import DistributedQueryRunner
+
+    rng = np.random.default_rng(3)
+    n = 5000
+    k = rng.integers(0, 97, n).astype(np.int64)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+
+    conn = MemoryConnector()
+    conn.create_table(
+        "b", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)],
+        bucketed_by=["k"], bucket_count=4,
+    )
+    conn.insert("b", {"k": k, "v": v})
+    flat = MemoryConnector()
+    flat.create_table("b", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)])
+    flat.insert("b", {"k": k, "v": v})
+
+    sql = "select k, sum(v) as s, count(*) as c from b group by k order by k"
+    runner = DistributedQueryRunner(num_workers=2, default_catalog="mem")
+    runner.register_catalog("mem", conn)
+    runner.start()
+    try:
+        # the distributed plan has NO repartition exchange
+        coord = runner.coordinator
+        plan = optimize(coord.planner.plan(sql), coord.catalogs, coord.session)
+        dplan = distribute(plan, coord.catalogs, 2, coord.session,
+                           connector_buckets=True)
+        kinds = [n.kind for n in walk(dplan) if isinstance(n, Exchange)]
+        assert "repartition" not in kinds, kinds
+        got = runner.query(sql)
+    finally:
+        runner.stop()
+
+    single = DistributedQueryRunner(num_workers=1, default_catalog="mem")
+    single.register_catalog("mem", flat)
+    single.start()
+    try:
+        want = single.query(sql)
+    finally:
+        single.stop()
+    assert got == want

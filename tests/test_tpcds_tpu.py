@@ -1,0 +1,96 @@
+"""On-hardware differential tier: TPC-DS subset on the real TPU chip.
+
+Same mechanism as tests/test_tpch_tpu.py (hardware subprocess, oracle diff
+in the parent) over twelve TPC-DS queries spanning star joins, date-dim
+filters, demographic cross joins, returns anti-joins, rollup, and
+rank-over-aggregate — the shapes where TPU numerics (f32 Kahan floors,
+emulated f64, limb-exact int64) could diverge from the CPU suite.
+
+Reference: the per-connector on-hardware variants of the engine suites
+(testing/trino-testing/.../AbstractTestQueries.java subclasses).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.oracle import SqliteOracle, assert_rows_equal
+from tests.tpcds_queries import ORDERED, QUERIES
+
+_HW = os.environ.get("TRINO_TPU_HW_PLATFORM", "")
+_SCALE = 0.002
+
+_TPU_QUERIES = [
+    "q03", "q07", "q19", "q42", "q52", "q55", "q65", "q68", "q79", "q85",
+    "q96", "q98",
+]
+
+_RUNNER = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import jax
+from trino_tpu.utils.compilecache import enable_persistent_cache
+enable_persistent_cache({repo!r})
+assert jax.default_backend() != "cpu", f"expected hardware, got {{jax.default_backend()}}"
+from tests.tpcds_queries import QUERIES
+from trino_tpu.connectors.tpcds import TpcdsConnector
+from trino_tpu.runtime.engine import Engine
+
+eng = Engine(default_catalog="tpcds")
+eng.register_catalog("tpcds", TpcdsConnector({scale}))
+out = {{}}
+for name in {names!r}:
+    rows = eng.query(QUERIES[name])
+    out[name] = [list(r) for r in rows]
+print("\nRESULT:" + json.dumps(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def tpcds_tpu_results():
+    if not _HW or _HW == "cpu":
+        pytest.skip("no TPU platform available (explicitly CPU)")
+    env = dict(os.environ)
+    if _HW == "auto":
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = _HW
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = _RUNNER.format(repo=repo, scale=_SCALE, names=_TPU_QUERIES)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=3600,
+    )
+    if proc.returncode != 0:
+        pytest.skip(
+            f"TPU subprocess failed (hardware unavailable?):\n{proc.stderr[-2000:]}"
+        )
+    payload = [l for l in proc.stdout.splitlines() if l.startswith("RESULT:")]
+    assert payload, f"no RESULT line:\n{proc.stdout[-2000:]}"
+    return json.loads(payload[-1][len("RESULT:"):])
+
+
+@pytest.fixture(scope="module")
+def tpcds_oracle_small():
+    from trino_tpu.connectors.tpcds import TPCDS_SCHEMAS, tpcds_data
+
+    needed = set()
+    for q in _TPU_QUERIES:
+        for t in TPCDS_SCHEMAS:
+            if t in QUERIES[q]:
+                needed.add(t)
+    return SqliteOracle(
+        {t: tpcds_data(t, _SCALE) for t in sorted(needed)},
+        schemas=TPCDS_SCHEMAS,
+    )
+
+
+@pytest.mark.parametrize("name", _TPU_QUERIES)
+def test_tpcds_on_tpu(name, tpcds_tpu_results, tpcds_oracle_small):
+    got = [tuple(r) for r in tpcds_tpu_results[name]]
+    want = tpcds_oracle_small.query(QUERIES[name])
+    assert_rows_equal(got, want, ordered=ORDERED[name], rtol=1e-6)

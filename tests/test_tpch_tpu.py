@@ -33,16 +33,14 @@ from tests.tpch_queries import QUERIES
 _HW = os.environ.get("TRINO_TPU_HW_PLATFORM", "")
 _SCALE = 0.01
 
-# Queries chosen to cover: dict-coded group-by (q01), filter boundaries on
-# DECIMAL columns + global agg (q06 — exact only because money columns are
-# scaled-int64 decimals; f32 "doubles" cannot hold the 0.06+0.01 boundary),
-# joins + high-cardinality group-by + topn (q03), large-state group-by +
-# having-subquery (q18), semi-join via EXISTS (q04), window functions
-# (w01), and the SPMD shard_map path on the chip itself (q03_dist runs
+# ALL 22 TPC-H queries run on the chip (round-4 verdict asked for the full
+# suite: TPU-specific numerics — Kahan f32 floors, f64 emulation, limb-exact
+# int64 — are only proven where they actually run), plus window coverage
+# (w01) and the SPMD shard_map path on the chip itself (q03_dist runs
 # through Engine(distributed=True) over a 1-device mesh — collectives
 # compile and execute on hardware).  The persistent compile cache keeps
-# repeat runs to seconds.
-_TPU_QUERIES = ["q01", "q06", "q03", "q18", "q04", "w01"]
+# repeat runs to seconds; the first run pays one compile per query.
+_TPU_QUERIES = sorted(QUERIES) + ["w01"]
 _TPU_DISTRIBUTED = ["q03"]  # run again through shard_map on the chip
 
 # window-function coverage (TPC-H itself has no OVER clauses)

@@ -2862,3 +2862,23 @@ order by c_last_name, c_first_name, s_store_name, paid
 limit 100
 """
 ORDERED["q24"] = True
+QUERIES["q67"] = """
+select * from
+ (select i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy,
+         s_store_id, sumsales,
+         rank() over (partition by i_category
+                      order by sumsales desc) rk
+  from (select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+               d_moy, s_store_id,
+               sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales
+        from store_sales, date_dim, store, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and ss_store_sk = s_store_sk and d_month_seq between 96 and 107
+        group by rollup(i_category, i_class, i_brand, i_product_name,
+                        d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+where rk <= 10
+order by i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy,
+         s_store_id, sumsales, rk
+limit 100
+"""
+ORDERED["q67"] = False  # rank ties

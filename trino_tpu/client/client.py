@@ -18,19 +18,43 @@ class QueryFailed(Exception):
 
 
 class StatementClient:
-    def __init__(self, server_url: str, poll_interval: float = 0.05):
+    def __init__(
+        self, server_url: str, poll_interval: float = 0.05,
+        spooled: bool = False,
+    ):
+        """spooled=True advertises the SPOOLED result protocol (reference:
+        client/spooling SegmentLoader): when the server has a spool
+        configured, results come back as segment URIs fetched out-of-band
+        (and acknowledged, releasing server storage) instead of inline."""
         self.server_url = server_url.rstrip("/")
         self.poll_interval = poll_interval
+        self.spooled = spooled
+
+    def _fetch_segments(self, state: dict) -> list[list]:
+        rows: list[list] = []
+        for seg in state["segments"]:
+            with urllib.request.urlopen(seg["uri"], timeout=60) as r:
+                rows.extend(json.loads(r.read()))
+            ack = urllib.request.Request(seg["uri"], method="DELETE")
+            try:
+                urllib.request.urlopen(ack, timeout=10).close()
+            except Exception:
+                pass  # best-effort release; server GC covers the rest
+        return rows
 
     def execute(self, sql: str, timeout: float = 600.0) -> tuple[list[str], list[list]]:
         """-> (column_names, rows)"""
+        headers = {"X-Trino-Spooled": "1"} if self.spooled else {}
         req = urllib.request.Request(
-            f"{self.server_url}/v1/statement", data=sql.encode()
+            f"{self.server_url}/v1/statement", data=sql.encode(),
+            headers=headers,
         )
         with urllib.request.urlopen(req, timeout=30) as r:
             state = json.loads(r.read())
         deadline = time.time() + timeout
         while True:
+            if "segments" in state:
+                return state.get("columns", []), self._fetch_segments(state)
             if "data" in state:
                 return state.get("columns", []), state["data"]
             if state.get("stats", {}).get("state") == "FAILED":

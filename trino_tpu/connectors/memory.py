@@ -20,6 +20,10 @@ class MemoryConnector(Connector):
     def __init__(self) -> None:
         self._tables: dict[str, TableSchema] = {}
         self._data: dict[str, dict[str, np.ndarray]] = {}
+        # table -> (bucket columns, bucket count) for bucketed tables
+        self._bucketing: dict[str, tuple[tuple[str, ...], int]] = {}
+        # (table, generation) -> per-bucket row-index arrays
+        self._bucket_rows: dict = {}
         self.generation = 0  # bumped on every write; invalidates scan caches
 
     # ---- metadata ----------------------------------------------------------
@@ -31,7 +35,13 @@ class MemoryConnector(Connector):
             raise KeyError(f"memory table not found: {table}")
         return self._tables[table]
 
-    def create_table(self, name: str, columns: Sequence[ColumnSchema]) -> None:
+    def create_table(
+        self,
+        name: str,
+        columns: Sequence[ColumnSchema],
+        bucketed_by: Optional[Sequence[str]] = None,
+        bucket_count: int = 0,
+    ) -> None:
         if name in self._tables:
             raise ValueError(f"table already exists: {name}")
         self._tables[name] = TableSchema(name, tuple(columns))
@@ -39,11 +49,23 @@ class MemoryConnector(Connector):
             c.name: np.empty((0,), dtype=object if c.type.is_string else c.type.np_dtype)
             for c in columns
         }
+        if bucketed_by:
+            # bucketing by the ENGINE's partition hash: scans of this table
+            # are born hash-partitioned, so joins/aggs on the bucket keys
+            # skip the repartition exchange (reference: trino-hive bucketed
+            # tables via ConnectorNodePartitioningProvider)
+            self._bucketing[name] = (tuple(bucketed_by), int(bucket_count) or 8)
         self.generation += 1
+
+    def table_partitioning(self, table: str):
+        return self._bucketing.get(table)
 
     def drop_table(self, name: str) -> None:
         self._tables.pop(name)
         self._data.pop(name)
+        self._bucketing.pop(name, None)
+        self._bucket_rows = {k: v for k, v in self._bucket_rows.items()
+                             if k[0] != name}
         self.generation += 1
 
     def truncate(self, name: str) -> None:
@@ -72,10 +94,38 @@ class MemoryConnector(Connector):
 
     # ---- reads -------------------------------------------------------------
     def get_splits(self, table: str, desired_parts: int) -> list[Split]:
+        bp = self._bucketing.get(table)
+        if bp is not None:
+            # one split per bucket, regardless of desired parallelism: the
+            # scheduler's round-robin (split i -> task i mod W) keeps the
+            # hash alignment whenever bucket_count % W == 0
+            return [Split("memory", table, b, bp[1]) for b in range(bp[1])]
         return [Split("memory", table, p, desired_parts) for p in range(desired_parts)]
+
+    def _bucket_index(self, table: str):
+        key = (table, self.generation)
+        rows = self._bucket_rows.get(key)
+        if rows is None:
+            from ..runtime.wire import bucket_assignments
+
+            cols, nb = self._bucketing[table]
+            data = self._data[table]
+            b = bucket_assignments({c: data[c] for c in cols}, cols, nb)
+            rows = [np.nonzero(b == i)[0] for i in range(nb)]
+            # per-TABLE cache, dropping only stale generations of this table
+            # (replacing the whole dict would evict other tables' indexes
+            # and re-pay per-row hashing on every alternating scan)
+            self._bucket_rows = {
+                k: v for k, v in self._bucket_rows.items() if k[0] != table
+            }
+            self._bucket_rows[key] = rows
+        return rows
 
     def read_split(self, split: Split, columns: Sequence[str]) -> dict[str, np.ndarray]:
         data = self._data[split.table]
+        if split.table in self._bucketing:
+            ix = self._bucket_index(split.table)[split.part]
+            return {c: data[c][ix] for c in columns}
         n = len(next(iter(data.values()))) if data else 0
         lo = split.part * n // split.num_parts
         hi = (split.part + 1) * n // split.num_parts

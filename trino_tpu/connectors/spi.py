@@ -140,6 +140,13 @@ class Connector(abc.ABC):
 
     name: str
 
+    def table_partitioning(self, table: str):
+        """(bucket columns, bucket count) for connector-bucketed tables, or
+        None (reference: spi/connector/ConnectorNodePartitioningProvider —
+        pre-partitioned tables execute without a reshuffle when the bucket
+        function matches the engine's hash partitioning)."""
+        return None
+
     @abc.abstractmethod
     def list_tables(self) -> list[str]: ...
 

@@ -379,8 +379,18 @@ class Page:
         live, host_cols = self._fetch_host()
         idx = np.nonzero(live)[0]
         out: list[np.ndarray] = []
-        for col, (hdata, hvalid) in zip(self.columns, host_cols):
+        for col, (hdata, hvalid, hdata2) in zip(self.columns, host_cols):
             data = np.asarray(hdata)[idx]
+            if hdata2 is not None:
+                # limbed decimal128: persist exact unscaled ints (object
+                # lanes) so a write+re-read round-trips through from_numpy
+                from .dec128 import combine_py
+
+                hi = np.asarray(hdata2)[idx]
+                vals = np.empty(len(data), dtype=object)
+                for i in range(len(data)):
+                    vals[i] = combine_py(int(hi[i]), int(data[i]))
+                data = vals
             if col.type.is_dict_object or col.type.is_string:
                 if len(idx):
                     data = col.dictionary.values[

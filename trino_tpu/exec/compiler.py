@@ -39,7 +39,12 @@ from ..plan.nodes import (
     TopN, Unnest, Values, Window,
 )
 
-__all__ = ["LocalExecutor"]
+__all__ = ["LocalExecutor", "MemoryBudgetExceeded"]
+
+
+class MemoryBudgetExceeded(RuntimeError):
+    """Planned capacities exceed the task's device-memory budget; the FTE
+    scheduler retries the task with an exponentially larger budget."""
 
 
 @dataclass
@@ -109,6 +114,12 @@ class LocalExecutor:
         self._table_pages: dict = {}  # page-object identity cache (CSE memo)
         self._table_live: dict = {}  # (catalog, table, gen, split) -> live rows
         self._jit_cache: dict = {}
+        # per-task device-memory budget in bytes (0/None = unlimited): the
+        # FTE scheduler grows this across task retries (reference:
+        # ExponentialGrowthPartitionMemoryEstimator); enforcement is an
+        # up-front estimate over planned capacities, the TPU analogue of
+        # reserving from a memory pool before running
+        self.memory_budget_bytes: Optional[int] = None
         # caps that completed a query without overflow, keyed by plan: repeat
         # executions skip the growth retries (the reference's runtime-adaptive
         # statistics feedback, AdaptivePlanner, in miniature)
@@ -281,6 +292,14 @@ class LocalExecutor:
                         break
                     for nid, req in overflow.items():
                         caps[nid] = _pow2(max(req, caps[nid] * 2))
+        budget = self.memory_budget_bytes
+        if budget:
+            est = self._estimate_bytes(inputs, caps)
+            if est > budget:
+                raise MemoryBudgetExceeded(
+                    f"task needs ~{est} bytes of device memory,"
+                    f" budget is {budget}"
+                )
         # plans with host-collected aggregates (array_agg/map_agg/listagg)
         # cannot trace: their outputs intern structured values on the host.
         # Run them eagerly — op-by-op dispatch with concrete arrays.
@@ -335,8 +354,16 @@ class LocalExecutor:
         cache_key = (plan, tuple(sorted(caps.items())),
                      tuple(sorted((k, p.capacity) for k, p in inputs.items())))
         fn, _holder = self._jit_cache[cache_key]
-        _, packed = fn(inputs)
+        out, packed = fn(inputs)
         jax.block_until_ready(packed)  # drain any pending work
+        # keeping many dispatches in flight also keeps every run's OUTPUT
+        # buffers alive at once; for queries whose working set is a big
+        # fraction of HBM that forces allocator thrash (measured: q18 SF1
+        # "pipelined" 23s vs 9.4s single-shot).  Cap in-flight runs by the
+        # estimated footprint so the measurement never self-sabotages.
+        est = self._estimate_bytes(inputs, self._learned_caps.get(plan, {}))
+        if est > 2_000_000_000:
+            iters = min(iters, 2)
         import time as _time
 
         t0 = _time.perf_counter()
@@ -344,6 +371,18 @@ class LocalExecutor:
             _, packed = fn(inputs)
         jax.block_until_ready(packed)
         return (_time.perf_counter() - t0) / iters
+
+    def _estimate_bytes(self, inputs, caps) -> int:
+        """Planned device-memory footprint: every stateful node's capacity
+        times a nominal row width, plus the resident input pages."""
+        total = 0
+        for page in inputs.values():
+            for col in page.columns:
+                total += int(col.capacity) * col.data.dtype.itemsize
+        ncols = max((len(p.columns) for p in inputs.values()), default=4)
+        for cap in caps.values():
+            total += int(cap) * 8 * ncols
+        return total
 
     def _initial_caps(self, nodes, inputs) -> dict[int, int]:
         # stats-fed first guesses (plan/stats.py: group-key NDV products,

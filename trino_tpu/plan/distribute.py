@@ -51,11 +51,18 @@ def distribute(
     catalogs: CatalogManager,
     num_devices: int,
     session=None,
+    connector_buckets: bool = False,
 ) -> PlanNode:
-    """Rewrite a single-node plan into an SPMD plan for `num_devices`."""
+    """Rewrite a single-node plan into an SPMD plan for `num_devices`.
+
+    connector_buckets: treat connector-bucketed scans as hash-partitioned
+    (only the multi-host worker runtime honors connector split routing; the
+    in-process SPMD executor shards scans by row range, where assuming
+    bucket alignment would be wrong)."""
     if num_devices <= 1:
         return plan
     d = _Distributor(catalogs, session, num_devices)
+    d.connector_buckets = connector_buckets
     node, part = d.visit(plan)
     if part.kind != "replicated":
         node = Exchange(node, "gather")
@@ -103,6 +110,26 @@ class _Distributor:
     # --------------------------------------------------------------- visitor
     def visit(self, node: PlanNode) -> tuple[PlanNode, _Part]:
         if isinstance(node, TableScan):
+            if getattr(self, "connector_buckets", False):
+                # bucketed table: the scan is BORN hash-partitioned on the
+                # bucket keys (reference: BucketNodeMap — bucketed execution
+                # skips the reshuffle) when buckets divide evenly over
+                # workers and the keys survive column pruning
+                conn = self.catalogs.get(node.catalog)
+                bp = conn.table_partitioning(node.table)
+                if bp is not None:
+                    cols, nb = bp
+                    if nb % self.num_devices == 0 and all(
+                        c in node.column_names for c in cols
+                    ):
+                        keys = tuple(
+                            FieldRef(
+                                node.column_names.index(c),
+                                node.output_types[node.column_names.index(c)],
+                            )
+                            for c in cols
+                        )
+                        return node, _Part("hash", keys)
             return node, _Part("any")
         if isinstance(node, Values):
             return node, _Part("replicated")

@@ -159,7 +159,7 @@ class Coordinator:
             raise RuntimeError(sm.error)
         return record["result"]
 
-    def submit_query(self, sql: str) -> str:
+    def submit_query(self, sql: str, spooled: bool = False) -> str:
         """Admission-controlled submit (reference: DispatchManager.createQuery
         queueing through resource groups before SqlQueryExecution starts).
         The query's declared memory budget counts against its group while it
@@ -171,6 +171,7 @@ class Coordinator:
         record = {
             "sm": sm, "sql": sql, "result": None, "columns": None,
             "done": threading.Event(),
+            "spooled": spooled and bool(self.session.get("client_spool_dir")),
         }
         with self._lock:
             self.queries[qid] = record
@@ -205,6 +206,7 @@ class Coordinator:
         record = {
             "sm": sm, "sql": sql, "result": None, "columns": None,
             "done": threading.Event(),
+            "spooled": False,  # nested statements always return rows inline
         }
         with self._lock:
             self.queries[qid] = record
@@ -212,6 +214,12 @@ class Coordinator:
         if sm.state == "FAILED":
             raise RuntimeError(sm.error)
         return record["result"]
+
+    def expire_query(self, qid: str) -> None:
+        """Forget a finished query and GC its spooled result segments."""
+        self.remove_spooled_result(qid)
+        with self._lock:
+            self.queries.pop(qid, None)
 
     def cancel_query(self, qid: str) -> bool:
         """Cancel a queued or running query (reference: DELETE
@@ -294,15 +302,37 @@ class Coordinator:
         nw = len(workers)
 
         plan = optimize(self.planner.plan(record["sql"]), self.catalogs, self.session)
-        dplan = distribute(plan, self.catalogs, nw, self.session)
+        dplan = distribute(plan, self.catalogs, nw, self.session,
+                           connector_buckets=True)
         fragments = fragment_plan(dplan)
         record["columns"] = list(plan.output_names)
 
         sm.transition("STARTING")
-        # task counts: result fragment runs on the coordinator; leaf/mid
-        # stages get one task per worker
-        ntasks = {f.id: (1 if f.output_kind == "result" else nw) for f in fragments}
         frag_by_id = {f.id: f for f in fragments}
+
+        def _task_count(f) -> int:
+            # result fragment runs on the coordinator; a fragment whose
+            # inputs are ALL replicated (gather/broadcast/single) and that
+            # scans no table computes the same output in every task — run
+            # ONE (reference: SystemPartitioningHandle SINGLE distribution;
+            # fixes duplicated keyless-aggregate branches under UNION ALL)
+            if f.output_kind == "result":
+                return 1
+            from ..plan.nodes import TableScan, walk
+
+            has_scan = any(isinstance(n, TableScan) for n in walk(f.root))
+            if (
+                not has_scan
+                and f.inputs
+                and all(
+                    frag_by_id[c].output_kind in ("gather", "broadcast", "single")
+                    for c in f.inputs
+                )
+            ):
+                return 1
+            return nw
+
+        ntasks = {f.id: _task_count(f) for f in fragments}
         consumer_of: dict[int, int] = {}
         for f in fragments:
             for child in f.inputs:
@@ -372,50 +402,108 @@ class Coordinator:
             return moved
 
         sm.transition("RUNNING")
+        # per-stage wall intervals (seconds since query start): EXPLAIN
+        # ANALYZE / tests read these to see sibling stages overlapping
+        stage_times: dict[int, tuple[float, float]] = {}
+        record["stage_times"] = stage_times
+        self.last_stage_times = stage_times
+        t_query0 = time.perf_counter()
+        heal_lock = threading.Lock()
+
+        def build_payload(f) -> tuple[dict, str]:
+            out_parts = ntasks[consumer_of[f.id]]
+            sources = self._sources_payload(f, frag_by_id, task_urls)
+            payload_base = {
+                "query_id": sm.query_id,
+                "fragment": plan_to_json(f.root),
+                "output_kind": f.output_kind,
+                "output_keys": [_encode(k) for k in f.output_keys],
+                "out_parts": out_parts,
+                "num_parts": ntasks[f.id],
+                "sources": sources,
+                # re-scheduled consumers must re-read sources from token
+                # 0, so TASK retry keeps producer chunks un-acked
+                "ack_sources": not phased,
+                "exchange_dir": spool_dir if spool is not None else None,
+                "memory_budget_bytes": int(
+                    self.session.get("task_memory_budget_bytes") or 0
+                ) or None,
+            }
+            tag = f"{sm.query_id}_a{attempt}_f{f.id}"
+            frag_meta[f.id] = (payload_base, tag)
+            return payload_base, tag
+
+        def run_fragment_phased(f) -> None:
+            if record.get("cancel"):
+                raise RuntimeError(
+                    record.get("kill_reason") or "Query was canceled"
+                )
+            t0 = time.perf_counter() - t_query0
+            payload_base, tag = build_payload(f)
+
+            def refresh_sources(f=f):
+                # a consumer task may have failed because a SOURCE
+                # worker died mid-query: recompute the producers it
+                # lost, then hand back the refreshed source URLs
+                with heal_lock:
+                    for child in f.inputs:
+                        heal(child)
+                    return self._sources_payload(f, frag_by_id, task_urls)
+
+            urls = self._run_stage_phased(
+                payload_base,
+                ntasks[f.id],
+                tag,
+                max_attempts=int(self.session.get("task_retry_attempts")),
+                posted=all_tasks,  # every posted task gets cleaned up
+                refresh_sources=refresh_sources,
+            )
+            task_urls[f.id] = urls
+            stage_times[f.id] = (t0, time.perf_counter() - t_query0)
+
         try:
-            for f in sorted(fragments, key=lambda f: -f.id):
-                if record.get("cancel"):
-                    raise RuntimeError(
-                        record.get("kill_reason") or "Query was canceled"
-                    )
-                if f.output_kind == "result":
-                    continue  # runs on coordinator below
-                out_parts = ntasks[consumer_of[f.id]]
-                sources = self._sources_payload(f, frag_by_id, task_urls)
-                payload_base = {
-                    "query_id": sm.query_id,
-                    "fragment": plan_to_json(f.root),
-                    "output_kind": f.output_kind,
-                    "output_keys": [_encode(k) for k in f.output_keys],
-                    "out_parts": out_parts,
-                    "num_parts": ntasks[f.id],
-                    "sources": sources,
-                    # re-scheduled consumers must re-read sources from token
-                    # 0, so TASK retry keeps producer chunks un-acked
-                    "ack_sources": not phased,
-                    "exchange_dir": spool_dir if spool is not None else None,
-                }
-                tag = f"{sm.query_id}_a{attempt}_f{f.id}"
-                frag_meta[f.id] = (payload_base, tag)
-                if phased:
-
-                    def refresh_sources(f=f):
-                        # a consumer task may have failed because a SOURCE
-                        # worker died mid-query: recompute the producers it
-                        # lost, then hand back the refreshed source URLs
-                        for child in f.inputs:
-                            heal(child)
-                        return self._sources_payload(f, frag_by_id, task_urls)
-
-                    urls = self._run_stage_phased(
-                        payload_base,
-                        ntasks[f.id],
-                        tag,
-                        max_attempts=int(self.session.get("task_retry_attempts")),
-                        posted=all_tasks,  # every posted task gets cleaned up
-                        refresh_sources=refresh_sources,
-                    )
-                else:
+            non_result = [f for f in fragments if f.output_kind != "result"]
+            if phased:
+                # PHASED with overlap (reference: scheduler/policy/
+                # PhasedExecutionSchedule.java — stages whose dependencies
+                # are satisfied run together): independent subtrees (sibling
+                # build sides, union branches) run CONCURRENTLY; each wave
+                # launches every fragment whose children have completed
+                done_ids: set[int] = set()
+                pending_f = {f.id: f for f in non_result}
+                while pending_f:
+                    ready = [
+                        f for f in pending_f.values()
+                        if all(c in done_ids for c in f.inputs)
+                    ]
+                    if not ready:
+                        raise RuntimeError("cyclic fragment graph")
+                    if len(ready) == 1:
+                        run_fragment_phased(ready[0])
+                    else:
+                        with ThreadPoolExecutor(
+                            max_workers=min(len(ready), 8)
+                        ) as pool:
+                            futs = [
+                                pool.submit(run_fragment_phased, f)
+                                for f in ready
+                            ]
+                            for fu in futs:
+                                fu.result()
+                    for f in ready:
+                        done_ids.add(f.id)
+                        del pending_f[f.id]
+            else:
+                # ALL-AT-ONCE: posting is non-blocking; workers long-poll
+                # their sources, so stages already overlap like the
+                # reference's pipelined scheduler
+                for f in sorted(non_result, key=lambda f: -f.id):
+                    if record.get("cancel"):
+                        raise RuntimeError(
+                            record.get("kill_reason") or "Query was canceled"
+                        )
+                    t0 = time.perf_counter() - t_query0
+                    payload_base, tag = build_payload(f)
                     urls = []
                     for p in range(ntasks[f.id]):
                         w = workers[p % nw]
@@ -423,7 +511,8 @@ class Coordinator:
                         all_tasks.append((w, task_id))  # before post: no leak
                         self._post_task(w, dict(payload_base, task_id=task_id, part=p))
                         urls.append((w, task_id))
-                task_urls[f.id] = urls
+                    task_urls[f.id] = urls
+                    stage_times[f.id] = (t0, time.perf_counter() - t_query0)
 
             # result fragment on the coordinator (COORDINATOR_DISTRIBUTION)
             from .worker import _stream_fetch
@@ -461,10 +550,76 @@ class Coordinator:
             sm.transition("FINISHING")
             page = executor.execute(root.root, remote_pages)
             record["result"] = page.to_pylist()
+            if record.get("spooled"):
+                self._spool_result(sm.query_id, record)
         finally:
             self._cleanup_tasks(all_tasks)
             if spool is not None:  # committed stage output dies with the query
                 spool.remove_query(sm.query_id)
+
+    # --------------------------------------------- spooled client protocol
+    _SPOOL_SEGMENT_ROWS = 65536
+
+    def _spool_result(self, qid: str, record: dict) -> None:
+        """Write finished result rows as on-disk segments and drop them from
+        coordinator RAM (reference: server/protocol/spooling — segments via
+        the SpoolingManager SPI; clients fetch them out-of-band)."""
+        import os
+
+        d = self.session.get("client_spool_dir")
+        os.makedirs(d, exist_ok=True)
+        rows = record["result"] or []
+        segs = []
+        for i in range(0, max(len(rows), 1), self._SPOOL_SEGMENT_ROWS):
+            chunk = rows[i: i + self._SPOOL_SEGMENT_ROWS]
+            path = os.path.join(d, f"{qid}_seg{len(segs)}.json")
+            with open(path, "w") as f:
+                json.dump([list(r) for r in chunk], f)
+            segs.append({"path": path, "count": len(chunk)})
+        record["segments"] = segs
+        record["result"] = []  # rows live on disk, not in RAM
+
+    def read_spooled_segment(self, qid: str, idx: int) -> Optional[bytes]:
+        record = self.queries.get(qid)
+        if record is None or not record.get("segments"):
+            return None
+        segs = record["segments"]
+        if not 0 <= idx < len(segs):
+            return None
+        try:
+            with open(segs[idx]["path"], "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def remove_spooled_result(self, qid: str) -> None:
+        """Server-side GC: drop any un-acked segment files for a query (a
+        crashed client never sends the acks)."""
+        import os
+
+        record = self.queries.get(qid)
+        for seg in (record or {}).get("segments") or []:
+            try:
+                os.unlink(seg["path"])
+            except OSError:
+                pass
+
+    def ack_spooled_segment(self, qid: str, idx: int) -> bool:
+        """Client acknowledges a fetched segment: its file is deleted
+        (reference: spooling segment ack releasing storage)."""
+        import os
+
+        record = self.queries.get(qid)
+        if record is None or not record.get("segments"):
+            return False
+        segs = record["segments"]
+        if not 0 <= idx < len(segs):
+            return False
+        try:
+            os.unlink(segs[idx]["path"])
+        except OSError:
+            pass
+        return True
 
     def _run_stage_phased(
         self,
@@ -486,11 +641,13 @@ class Coordinator:
         attempts = [0] * nparts
         pending: dict[int, tuple[str, str]] = {}
 
-        def try_post(p: int, w: str, task_id: str) -> bool:
+        def try_post(p: int, w: str, task_id: str, payload=None) -> bool:
             if posted is not None:
                 posted.append((w, task_id))
             try:
-                self._post_task(w, dict(payload_base, task_id=task_id, part=p))
+                self._post_task(
+                    w, dict(payload or payload_base, task_id=task_id, part=p)
+                )
                 return True
             except Exception:
                 return False  # dead/unreachable worker: reschedule below
@@ -530,7 +687,22 @@ class Coordinator:
                         )
                     w = alive[(p + attempts[p]) % len(alive)]
                     task_id = f"{tag}_p{p}_t{attempts[p]}"
-                    try_post(p, w, task_id)
+                    payload_p = payload_base
+                    if payload_base.get("memory_budget_bytes"):
+                        # the failure may have been a memory-budget refusal:
+                        # THIS part re-runs with a 4x-per-attempt estimate,
+                        # NOT identically (reference: ExponentialGrowth
+                        # PartitionMemoryEstimator).  Scoped per part — a
+                        # shared compounding budget would evaporate the
+                        # limit after unrelated worker-death retries
+                        payload_p = dict(
+                            payload_base,
+                            memory_budget_bytes=(
+                                payload_base["memory_budget_bytes"]
+                                * 4 ** attempts[p]
+                            ),
+                        )
+                    try_post(p, w, task_id, payload_p)
                     pending[p] = (w, task_id)
             for p in done:
                 del pending[p]
@@ -728,7 +900,8 @@ def _make_handler(coord: Coordinator):
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "statement"]:
                 sql = body.decode()
-                qid = coord.submit_query(sql)
+                spooled = self.headers.get("X-Trino-Spooled") == "1"
+                qid = coord.submit_query(sql, spooled=spooled)
                 return self._send_json(
                     200,
                     {"id": qid, "nextUri": f"{coord.url}/v1/statement/{qid}/0"},
@@ -741,6 +914,11 @@ def _make_handler(coord: Coordinator):
 
         def do_DELETE(self):
             parts = self.path.strip("/").split("/")
+            if parts[:2] == ["v1", "spooled"] and len(parts) >= 4:
+                if not parts[3].isdigit():
+                    return self._send_json(404, {"error": "no such segment"})
+                ok = coord.ack_spooled_segment(parts[2], int(parts[3]))
+                return self._send_json(200 if ok else 404, {"acked": ok})
             if parts[:2] == ["v1", "statement"] and len(parts) >= 3:
                 ok = coord.cancel_query(parts[2])
                 return self._send_json(200 if ok else 404, {"canceled": ok})
@@ -827,6 +1005,22 @@ def _make_handler(coord: Coordinator):
                         200,
                         {"id": qid, "stats": {"state": "FAILED"}, "error": sm.error},
                     )
+                if record.get("segments") is not None:
+                    return self._send_json(
+                        200,
+                        {
+                            "id": qid,
+                            "stats": {"state": sm.state},
+                            "columns": record["columns"],
+                            "segments": [
+                                {
+                                    "uri": f"{coord.url}/v1/spooled/{qid}/{i}",
+                                    "count": seg["count"],
+                                }
+                                for i, seg in enumerate(record["segments"])
+                            ],
+                        },
+                    )
                 return self._send_json(
                     200,
                     {
@@ -836,6 +1030,18 @@ def _make_handler(coord: Coordinator):
                         "data": [list(r) for r in record["result"]],
                     },
                 )
+            if parts[:2] == ["v1", "spooled"] and len(parts) >= 4:
+                if not parts[3].isdigit():
+                    return self._send_json(404, {"error": "no such segment"})
+                blob = coord.read_spooled_segment(parts[2], int(parts[3]))
+                if blob is None:
+                    return self._send_json(404, {"error": "no such segment"})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+                return
             return self._send_json(404, {"error": "not found"})
 
     return Handler

@@ -60,6 +60,15 @@ PROPERTIES: dict[str, _Prop] = {
             "max attempts per task under retry_policy=TASK",
             lambda v: v >= 1,
         ),
+        _Prop(
+            "task_memory_budget_bytes", int, 0,
+            "per-task device-memory budget enforced by the worker executor "
+            "(0 = unlimited); retried tasks get an exponentially GROWN "
+            "budget (reference: ExponentialGrowthPartitionMemoryEstimator "
+            "in the FTE scheduler — a task that died on memory re-runs "
+            "with a bigger estimate, not identically)",
+            lambda v: v >= 0,
+        ),
         _Prop("explain_format", str, "text", "text | json", None),
         _Prop(
             "resource_group", str, "global",
@@ -73,6 +82,17 @@ PROPERTIES: dict[str, _Prop] = {
             "regions (plan/reorder.py; reference: ReorderJoins.java + the "
             "benchto variable of the same name)",
             lambda v: v in ("AUTOMATIC", "NONE"),
+        ),
+        _Prop(
+            "client_spool_dir", str, "",
+            "directory for SPOOLED client results (reference: server/"
+            "protocol/spooling + spi/spool/SpoolingManager): when set and "
+            "the client advertises spooling (X-Trino-Spooled header), "
+            "finished results are written as row segments on disk and the "
+            "protocol returns segment URIs instead of inline data — the "
+            "coordinator holds no result rows in RAM and the client "
+            "fetches segments at its own pace",
+            None,
         ),
         _Prop(
             "exchange_spool_dir", str, "",

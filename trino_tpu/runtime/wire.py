@@ -193,6 +193,45 @@ def wire_to_page(
     return Page(tuple(columns), live)
 
 
+def bucket_assignments(
+    arrays: dict, key_cols: Sequence[str], nbuckets: int
+) -> "np.ndarray":
+    """Row -> bucket id using THE engine partition hash (identical chain to
+    partition_page below and the device exchange), so connector-bucketed
+    tables align with engine hash partitioning (reference:
+    ConnectorNodePartitioningProvider + BucketNodeMap).  NULL keys route to
+    bucket 0, matching the exchanges."""
+    import hashlib
+
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    h = np.zeros(n, dtype=np.uint64)
+    ok = np.ones(n, dtype=bool)
+    for c in key_cols:
+        vals = arrays[c]
+        if isinstance(vals, np.ma.MaskedArray):
+            ok &= ~np.ma.getmaskarray(vals)
+            vals = np.ma.getdata(vals)
+        if vals.dtype == object:
+            # string value-hash: same blake2b-8 as Dictionary.hash64()
+            bits = np.asarray(
+                [
+                    int.from_bytes(
+                        hashlib.blake2b(str(v).encode(), digest_size=8).digest(),
+                        "little",
+                    )
+                    for v in vals
+                ],
+                dtype=np.uint64,
+            )
+        elif np.issubdtype(vals.dtype, np.floating):
+            bits = vals.astype(np.float64).view(np.uint64)
+        else:
+            bits = vals.astype(np.int64).view(np.uint64)
+        h = _mix64_np(h ^ _mix64_np(bits))
+    b = (h % np.uint64(max(nbuckets, 1))).astype(np.int64)
+    return np.where(ok, b, 0)
+
+
 def partition_page(
     page: Page, keys: Sequence[IrExpr], nparts: int, chunk_rows: int = 0
 ) -> list[list[bytes]]:

@@ -212,6 +212,8 @@ class Worker:
             fragment = plan_from_json(req["fragment"])
             executor = LocalExecutor(self.catalogs, self.default_catalog)
             executor.split = (req["part"], req["num_parts"])
+            if req.get("memory_budget_bytes"):
+                executor.memory_budget_bytes = int(req["memory_budget_bytes"])
 
             remote_pages: dict[int, Page] = {}
             for fid_str, src in req.get("sources", {}).items():
