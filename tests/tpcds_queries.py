@@ -2882,3 +2882,135 @@ order by i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy,
 limit 100
 """
 ORDERED["q67"] = False  # rank ties
+
+QUERIES["q08"] = """
+select s_store_name, sum(ss_net_profit) as profit
+from store_sales, date_dim, store,
+     (select ca_zip from (
+        select substring(ca_zip, 1, 5) ca_zip from customer_address
+        where substring(ca_zip, 1, 5) in ('68894', '19479', '40984', '74628',
+                                          '77329', '99348', '50193', '49810')
+        intersect
+        select ca_zip from (
+          select substring(ca_zip, 1, 5) ca_zip, count(*) cnt
+          from customer_address, customer
+          where ca_address_sk = c_current_addr_sk
+            and c_preferred_cust_flag = 'Y'
+          group by substring(ca_zip, 1, 5)
+          having count(*) > 2) a1) a2) v1
+where ss_store_sk = s_store_sk and ss_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2000
+  and substring(s_zip, 1, 2) = substring(v1.ca_zip, 1, 2)
+group by s_store_name
+order by s_store_name
+limit 100
+"""
+ORDERED["q08"] = True
+
+QUERIES["q54"] = """
+with my_customers as (
+ select distinct c_customer_sk, c_current_addr_sk
+ from (select cs_sold_date_sk sold_date_sk,
+              cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+       from catalog_sales
+       union all
+       select ws_sold_date_sk sold_date_sk,
+              ws_bill_customer_sk customer_sk, ws_item_sk item_sk
+       from web_sales) cs_or_ws_sales, item, date_dim, customer
+ where sold_date_sk = d_date_sk and item_sk = i_item_sk
+   and i_category = 'Books' and i_class = 'fiction'
+   and c_customer_sk = cs_or_ws_sales.customer_sk
+   and d_moy = 3 and d_year = 2000),
+ my_revenue as (
+ select c_customer_sk, sum(ss_ext_sales_price) as revenue
+ from my_customers, store_sales, customer_address, store, date_dim
+ where c_current_addr_sk = ca_address_sk
+   and ca_county = s_county and ca_state = s_state
+   and ss_customer_sk = c_customer_sk
+   and ss_sold_date_sk = d_date_sk
+   and d_month_seq between (select distinct d_month_seq + 1 from date_dim
+                            where d_year = 2000 and d_moy = 3)
+                       and (select distinct d_month_seq + 3 from date_dim
+                            where d_year = 2000 and d_moy = 3)
+ group by c_customer_sk),
+ segments as (select cast((revenue / 50) as bigint) as segment
+              from my_revenue)
+select segment, count(*) as num_customers, segment * 50 as segment_base
+from segments
+group by segment
+order by segment, num_customers
+limit 100
+"""
+ORDERED["q54"] = True
+
+QUERIES["q14"] = """
+with cross_items as
+ (select i_item_sk ss_item_sk
+  from item,
+   (select iss.i_brand_id brand_id, iss.i_class_id class_id,
+           iss.i_category_id category_id
+    from store_sales, item iss, date_dim d1
+    where ss_item_sk = iss.i_item_sk and ss_sold_date_sk = d1.d_date_sk
+      and d1.d_year between 1999 and 2001
+    intersect
+    select ics.i_brand_id, ics.i_class_id, ics.i_category_id
+    from catalog_sales, item ics, date_dim d2
+    where cs_item_sk = ics.i_item_sk and cs_sold_date_sk = d2.d_date_sk
+      and d2.d_year between 1999 and 2001
+    intersect
+    select iws.i_brand_id, iws.i_class_id, iws.i_category_id
+    from web_sales, item iws, date_dim d3
+    where ws_item_sk = iws.i_item_sk and ws_sold_date_sk = d3.d_date_sk
+      and d3.d_year between 1999 and 2001) x
+  where i_brand_id = brand_id and i_class_id = class_id
+    and i_category_id = category_id),
+ avg_sales as
+ (select avg(quantity * list_price) average_sales
+  from (select ss_quantity quantity, ss_list_price list_price
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk and d_year between 1999 and 2001
+        union all
+        select cs_quantity quantity, cs_list_price list_price
+        from catalog_sales, date_dim
+        where cs_sold_date_sk = d_date_sk and d_year between 1999 and 2001
+        union all
+        select ws_quantity quantity, ws_list_price list_price
+        from web_sales, date_dim
+        where ws_sold_date_sk = d_date_sk and d_year between 1999 and 2001) x)
+select channel, i_brand_id, i_class_id, i_category_id,
+       sum(sales) as sum_sales, sum(number_sales) as sum_number_sales
+from (select 'store' channel, i_brand_id, i_class_id, i_category_id,
+             sum(ss_quantity * ss_list_price) sales, count(*) number_sales
+      from store_sales, item, date_dim
+      where ss_item_sk in (select ss_item_sk from cross_items)
+        and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(ss_quantity * ss_list_price) >
+             (select average_sales from avg_sales)
+      union all
+      select 'catalog' channel, i_brand_id, i_class_id, i_category_id,
+             sum(cs_quantity * cs_list_price) sales, count(*) number_sales
+      from catalog_sales, item, date_dim
+      where cs_item_sk in (select ss_item_sk from cross_items)
+        and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(cs_quantity * cs_list_price) >
+             (select average_sales from avg_sales)
+      union all
+      select 'web' channel, i_brand_id, i_class_id, i_category_id,
+             sum(ws_quantity * ws_list_price) sales, count(*) number_sales
+      from web_sales, item, date_dim
+      where ws_item_sk in (select ss_item_sk from cross_items)
+        and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(ws_quantity * ws_list_price) >
+             (select average_sales from avg_sales)) y
+group by rollup(channel, i_brand_id, i_class_id, i_category_id)
+order by channel, i_brand_id, i_class_id, i_category_id, sum_sales,
+         sum_number_sales
+limit 100
+"""
+ORDERED["q14"] = True
