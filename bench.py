@@ -30,14 +30,16 @@ with provenance in BASELINE_SQLITE.json (committed) so repeat runs don't
 re-pay the sqlite build+scan.
 
 Compile-latency guard (round-4 regression: q03 cold warm-up hit 407s):
-any query whose warm_s exceeds BENCH_WARM_BOUND (default 120s) is flagged
-in `warm_regressions` — a loud signal in the recorded bench JSON.
+any query whose warm_s exceeds BENCH_WARM_BOUND (default 240s — warm_s
+covers TWO warm executes: the initial compile and the adaptive-compaction
+tightened-tier recompile) is flagged in `warm_regressions` — a loud signal
+in the recorded bench JSON.
 
 Env knobs: BENCH_SF (default 1), BENCH_RUNS (default 5),
 BENCH_QUERIES (default q18,q03,q01,q06), BENCH_BUDGET_S (default 420),
 BENCH_TPCDS (default q64,q95 at scale 0.01; empty disables),
 BENCH_SF10_Q3 (default auto: runs if budget headroom remains),
-BENCH_WARM_BOUND (default 120).
+BENCH_WARM_BOUND (default 240).
 """
 
 import json
@@ -169,7 +171,7 @@ def main() -> None:
     runs = int(os.environ.get("BENCH_RUNS", "5"))
     qnames = os.environ.get("BENCH_QUERIES", "q18,q03,q01,q06").split(",")
     deadline = _Deadline(float(os.environ.get("BENCH_BUDGET_S", "420")))
-    warm_bound = float(os.environ.get("BENCH_WARM_BOUND", "120"))
+    warm_bound = float(os.environ.get("BENCH_WARM_BOUND", "240"))
 
     from trino_tpu.connectors.tpch import TpchConnector, tpch_data
     from trino_tpu.runtime.engine import Engine
@@ -209,6 +211,10 @@ def main() -> None:
             t0 = time.perf_counter()
             plan = eng.plan(QUERIES[name])
             eng.executor.execute(plan)  # warm: generation + upload + compile
+            # second warm: adaptive compaction may have TIGHTENED capacity
+            # tiers after observing true row counts (exec/compiler.py) — the
+            # tightened program compiles here, not inside the timed runs
+            eng.executor.execute(plan)
             warm_s = time.perf_counter() - t0
             if warm_s > warm_bound:
                 result["warm_regressions"].append(

@@ -127,3 +127,18 @@ def test_small_values_stay_single_lane():
 def test_unsupported_ops_refuse_loudly(d128_engine):
     with pytest.raises(NotImplementedError):
         d128_engine.query("select x from big order by x")
+
+
+def test_mul128(d128_engine):
+    """decimal128 multiplication (Int128Math.multiply analogue): exact
+    low-128 products, including big x small and sign combinations."""
+    rows = d128_engine.query("select x * 3, x * y from big where x = 1180591620717411303424")
+    (r3, rxy), = rows
+    v = 2**70
+    assert int(r3) == v * 3
+    wrapped = (v * (v + 1)) % (1 << 128)  # low 128 bits, signed
+    if wrapped >= 1 << 127:
+        wrapped -= 1 << 128
+    assert int(rxy) == wrapped
+    rows = d128_engine.query("select sum(x * 2) from big")
+    assert int(rows[0][0]) == 2 * sum(BIG)

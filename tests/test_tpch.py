@@ -27,3 +27,51 @@ def test_tpch_query(name, engine, oracle):
     got = engine.query(sql)
     expected = oracle.query(sql)
     assert_rows_equal(got, expected, ordered=ORDERED[name])
+
+
+def test_adaptive_compaction_tightens_and_stays_correct():
+    """Compact points (plan/optimizer.py insert_compaction) start as
+    pass-throughs; after one run the executor shrinks them to the OBSERVED
+    surviving count (the AdaptivePlanner-style runtime feedback), and
+    results stay identical.  Uses a selective filter over a >=64k-row
+    frame (the insertion gate)."""
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.plan.nodes import Compact, walk
+    from trino_tpu.runtime.engine import Engine
+
+    rng = np.random.default_rng(9)
+    n = 200_000
+    conn = MemoryConnector()
+    conn.create_table(
+        "big", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)]
+    )
+    conn.insert("big", {
+        "k": rng.integers(0, 1_000_000, n).astype(np.int64),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", conn)
+    sql = "select sum(v), count(*) from big where k < 500"  # ~0.05% survive
+    plan = eng.plan(sql)
+    compacts = [i for i, x in enumerate(walk(plan)) if isinstance(x, Compact)]
+    assert compacts, "no compaction point inserted over a 200k-row filter"
+    got1 = eng.query(sql)
+    caps1 = dict(eng.executor._learned_caps[plan])
+    got2 = eng.query(sql)  # runs at the tightened tier
+    ks = np.asarray(conn._data["big"]["k"])
+    vs = np.asarray(conn._data["big"]["v"])
+    want = [(int(vs[ks < 500].sum()), int((ks < 500).sum()))]
+    assert got1 == want and got2 == want
+    # at least one compact tier collapsed far below the 200k input frame
+    from trino_tpu.exec.compiler import _node_ids
+
+    node_ids = _node_ids(plan)
+    tight = [
+        caps1[i] for i in caps1
+        if isinstance(node_ids.get(i), Compact) and caps1[i] <= 16384
+    ]
+    assert tight, f"no compact tier tightened: {caps1}"

@@ -24,7 +24,8 @@ import numpy as np
 
 __all__ = [
     "needs_limbs", "split_py", "combine_py", "to_limbs", "from_limbs",
-    "add128", "sub128", "neg128", "cmp128", "limbs32", "recombine32",
+    "add128", "sub128", "neg128", "cmp128", "limbs32", "mul128",
+    "recombine32",
 ]
 
 _U64 = 1 << 64
@@ -134,4 +135,41 @@ def recombine32(s0, s1, s2, s3):
     hi = hi + s2
     # add s3 * 2^96
     hi = hi + (s3 << 32)
+    return lo, hi
+
+
+def mul128(alo, ahi, blo, bhi):
+    """128x128 -> low 128 bits (two's-complement wrap, like
+    Int128Math.multiply before its overflow check): schoolbook product over
+    32-bit limbs.  Each partial product of two 32-bit limbs is exact in the
+    low 64 bits of an int64 multiply; accumulators carry-propagate at the
+    end.  Trino raises on overflow past precision 38 at the type boundary;
+    lanes here wrap (the planner caps result precision at 38)."""
+    a = limbs32(alo, ahi)
+    b = limbs32(blo, bhi)
+    mask = jnp.asarray(_MASK32, alo.dtype)
+    # r[k] accumulates sum of a[i]*b[j] (i+j == k) split into 32-bit chunks;
+    # each a[i], b[j] is in [0, 2^32) except the top limbs, which are signed
+    # — for wrap-around low-128 results the signed top limbs still
+    # contribute correctly through the int64 wrap.
+    r = [jnp.zeros_like(alo) for _ in range(4)]
+    carry_to = [jnp.zeros_like(alo) for _ in range(5)]
+    for i in range(4):
+        for j in range(4 - i):
+            p = a[i] * b[j]  # wraps: low 64 bits exact
+            k = i + j
+            lo32 = p & mask
+            hi32 = _u(p).astype(p.dtype) >> 32
+            r[k] = r[k] + lo32
+            if k + 1 < 4:
+                carry_to[k + 1] = carry_to[k + 1] + (hi32 & mask)
+    # propagate: each r[k] may exceed 32 bits after summing <=4 partials
+    out = []
+    carry = jnp.zeros_like(alo)
+    for k in range(4):
+        tot = r[k] + carry_to[k] + carry
+        out.append(tot & mask)
+        carry = _u(tot).astype(tot.dtype) >> 32
+    lo = out[0] | (out[1] << 32)
+    hi = out[2] | (out[3] << 32)
     return lo, hi

@@ -30,13 +30,13 @@ from ..data.page import Column, Page
 from ..data.types import Type
 from ..ops.expr import ColumnVal, column_val, eval_expr, eval_predicate
 from ..ops.relops import (
-    AggSpec, SortSpec, broadcast_single_row, equi_join, group_aggregate,
-    limit_mask, sort_rows, top_n, unnest_expand,
+    AggSpec, SortSpec, broadcast_single_row, compact_rows, equi_join,
+    group_aggregate, limit_mask, sort_rows, top_n, unnest_expand,
 )
 from ..plan.nodes import (
-    Aggregate, Concat, Distinct, EnforceSingleRow, Exchange, Filter, Join,
-    Limit, MatchRecognize, PlanNode, Project, RemoteSource, Sort, TableScan,
-    TopN, Unnest, Values, Window,
+    Aggregate, Compact, Concat, Distinct, EnforceSingleRow, Exchange, Filter,
+    Join, Limit, MatchRecognize, PlanNode, Project, RemoteSource, Sort,
+    TableScan, TopN, Unnest, Values, Window,
 )
 
 __all__ = ["LocalExecutor", "MemoryBudgetExceeded"]
@@ -321,6 +321,19 @@ class LocalExecutor:
                 if nid in caps and int(req) > caps[nid]
             }
             if not overflow:
+                # adaptive compaction (reference: AdaptivePlanner fed by
+                # runtime stats): Compact points whose observed surviving
+                # count collapses far below their tier get a TIGHT tier for
+                # every later run (and, via the caps cache, later processes)
+                for nid, n in nodes.items():
+                    if not isinstance(n, Compact) or nid not in caps:
+                        continue
+                    req = required.get(nid)
+                    if req is None:
+                        continue
+                    tight = _pow2(int(req) * 2 + 1024)
+                    if tight < caps[nid]:
+                        caps[nid] = tight
                 self._learned_caps[plan] = caps
                 from .capcache import store_caps
 
@@ -434,6 +447,12 @@ class LocalExecutor:
                     return caps[nid] + child_sizes[0]
                 if n.kind == "full":
                     return caps[nid] + child_sizes[0] + child_sizes[1]
+                return caps[nid]
+            if isinstance(n, Compact):
+                # start as a pass-through (cap = input frame): whether this
+                # point actually compacts is learned from the first run's
+                # TRUE surviving count (the shrink in execute())
+                caps[nid] = _pow2(max(child_sizes[0], 1))
                 return caps[nid]
             if isinstance(n, TopN):
                 # radix-select candidate buffer (ops/relops.py top_n): room
@@ -656,6 +675,19 @@ def _trace_plan(
             s = emit(node.child)
             mask = eval_predicate(node.predicate, s.cols, s.capacity)
             return _Stage(s.cols, s.live & mask)
+
+        if isinstance(node, Compact):
+            s = emit(node.child)
+            C = caps.get(nid, s.capacity)  # unset (SPMD) == pass-through
+            if C >= s.capacity:
+                # pass-through tier: nothing to gain — but REPORT the live
+                # count so the post-run shrink can learn the true surviving
+                # rows and tighten this point for later runs
+                report(nid, jnp.sum(s.live.astype(jnp.int64)))
+                return s
+            cols, live, req = compact_rows(s.cols, s.live, C)
+            report(nid, req)
+            return _Stage(cols, live)
 
         if isinstance(node, Project):
             s = emit(node.child)

@@ -175,6 +175,14 @@ class SpmdExecutor(LocalExecutor):
                 return caps[nid]
             if isinstance(n, TopN):
                 return min(n.count, child_sizes[0])
+            from ..plan.nodes import Compact as _Compact
+
+            if isinstance(n, _Compact):
+                # SPMD leaves compaction points as pass-throughs (per-shard
+                # capacities already divide by D; the adaptive shrink is a
+                # LocalExecutor feature)
+                caps[nid] = _pow2(max(child_sizes[0], 1))
+                return child_sizes[0]
             from ..plan.nodes import Unnest, Values
 
             if isinstance(n, Values):

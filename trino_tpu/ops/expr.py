@@ -128,6 +128,19 @@ def _const_val(e: Const, n: int) -> ColumnVal:
         # a string literal used as a value (not in a comparison): 1-entry dict
         d = Dictionary(np.asarray([e.value], dtype=object))
         return ColumnVal(jnp.zeros((n,), dtype=jnp.int32), None, d, e.type)
+    if (
+        e.type.is_decimal
+        and isinstance(e.value, int)
+        and not -(1 << 63) <= e.value < (1 << 63)
+    ):
+        # beyond-int64 decimal literal: two-limb lanes (data/dec128.py)
+        from ..data.dec128 import split_py
+
+        hi, lo = split_py(e.value)
+        return ColumnVal(
+            jnp.full((n,), lo, dtype=jnp.int64), None, None, e.type,
+            data2=jnp.full((n,), hi, dtype=jnp.int64),
+        )
     return ColumnVal(
         jnp.full((n,), e.value, dtype=_np_to_jnp(e.type)), None, None, e.type
     )
@@ -227,6 +240,14 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         op in ("neg", "abs", "eq", "ne", "lt", "le", "gt", "ge", "add", "sub",
                "mul", "div", "mod")
         and any(v.data2 is not None for v in args)
+    ) or (
+        # single-lane decimal product whose RESULT type exceeds int64
+        # digits: compute at 128-bit width rather than silently wrapping
+        # the int64 lanes (reference: Int128Math.multiply)
+        op == "mul"
+        and e.type.is_decimal
+        and e.type.precision > 18
+        and all(v.type is not None and v.type.is_decimal for v in args)
     ):
         return _limbed_op(op, args, valid, e)
     if op == "neg":
@@ -1468,11 +1489,15 @@ def _limbed_op(op: str, args, valid, e) -> ColumnVal:
     scale-aligned by the planner, like the single-lane decimal path."""
     from ..data import dec128 as d
 
-    if op in ("mul", "div", "mod"):
+    if op in ("div", "mod"):
         raise NotImplementedError(
-            f"decimal128 {op} (128-bit multiply/divide lanes)"
+            f"decimal128 {op} (128-bit divide lanes; cast to double instead)"
         )
     alo, ahi = _as_limbs(args[0])
+    if op == "mul":
+        blo, bhi = _as_limbs(args[1])
+        lo, hi = d.mul128(alo, ahi, blo, bhi)
+        return ColumnVal(lo, valid, None, e.type, data2=hi)
     if op == "neg":
         lo, hi = d.neg128(alo, ahi)
         return ColumnVal(lo, valid, None, e.type, data2=hi)

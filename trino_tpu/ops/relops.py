@@ -34,7 +34,8 @@ from .expr import ColumnVal
 
 __all__ = [
     "group_aggregate", "equi_join", "broadcast_single_row", "sort_rows",
-    "top_n", "limit_mask", "unnest_expand", "AggSpec", "SortSpec",
+    "compact_rows", "top_n", "limit_mask", "unnest_expand", "AggSpec",
+    "SortSpec",
 ]
 
 
@@ -1170,6 +1171,32 @@ def broadcast_single_row(
 
 
 # ------------------------------------------------------------- sort / topn
+
+
+def compact_rows(cols, live, cap: int):
+    """Gather live rows into `cap` lanes (dead lanes drop).  Sort-based:
+    one 2-operand bitonic pass moves live rows to the front in original
+    order (stable), then every column gathers the first `cap` positions —
+    no scatter (TPU scatters serialize).  Returns (cols, live, required)
+    with required = true live count for the capacity-retry protocol."""
+    n = live.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    perm = jax.lax.sort([(~live).astype(jnp.int8), iota], num_keys=2,
+                        is_stable=True)[-1]
+    take = perm[:cap]
+    required = jnp.sum(live.astype(jnp.int64))
+    out = [
+        ColumnVal(
+            jnp.take(cv.data, take),
+            None if cv.valid is None else jnp.take(cv.valid, take),
+            cv.dict,
+            cv.type,
+            None if cv.data2 is None else jnp.take(cv.data2, take),
+        )
+        for cv in cols
+    ]
+    out_live = jnp.arange(cap, dtype=jnp.int64) < jnp.minimum(required, cap)
+    return out, out_live, required
 
 
 def sort_rows(

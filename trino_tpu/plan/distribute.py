@@ -138,6 +138,12 @@ class _Distributor:
             child, part = self.visit(node.child)
             return Filter(child, node.predicate), part
 
+        from .nodes import Compact as _Compact
+
+        if isinstance(node, _Compact):
+            child, part = self.visit(node.child)
+            return _Compact(child), part
+
         if isinstance(node, EnforceSingleRow):
             # the at-most-one-row check must see ALL rows once: gather
             # partitioned input (a per-device count would under-report)

@@ -22,7 +22,7 @@ from .ir import IrExpr
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
     "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
-    "Exchange", "Unnest", "EnforceSingleRow", "MatchRecognize",
+    "Exchange", "Unnest", "EnforceSingleRow", "MatchRecognize", "Compact",
 ]
 
 
@@ -56,6 +56,33 @@ class TableScan(PlanNode):
 class Filter(PlanNode):
     child: PlanNode
     predicate: IrExpr  # boolean IR over child's output
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_names(self):
+        return self.child.output_names
+
+    @property
+    def output_types(self):
+        return self.child.output_types
+
+
+@dataclass(frozen=True)
+class Compact(PlanNode):
+    """Collapse dead lanes: gather live rows into a SMALL static capacity.
+
+    The mask-based data plane never shrinks frames — a selective filter or
+    semi-join leaves millions of dead lanes that every downstream sort,
+    join and aggregation still pays lane cost for (the reference has no
+    analogue because its Pages physically shrink; this is the TPU
+    equivalent of SelectedPositions compaction in PageProcessor).  The
+    optimizer inserts Compact where estimated rows collapse far below the
+    frame; the capacity-retry protocol sizes the output frame."""
+
+    child: PlanNode
 
     @property
     def children(self):

@@ -46,7 +46,79 @@ def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
     # prune AFTER reordering: the restoring projections reorder_joins leaves
     # behind get folded into the scans here
     plan = prune_columns(plan)
+    if catalogs is not None:
+        plan = insert_compaction(plan, catalogs)
     return plan
+
+
+# Compaction points are inserted wherever a BIG frame might collapse
+# (filters and semi/anti/mark membership tests over >=64k-lane inputs).
+# Whether each point actually compacts is decided at RUNTIME: the initial
+# capacity starts at the stats estimate (usually ~= the input frame, a
+# pass-through no-op), and after a run observes the TRUE surviving count
+# the executor shrinks the tier (exec/compiler.py) — the one extra
+# 2-operand sort then pays for itself because EVERY downstream
+# sort/join/aggregation runs at the collapsed capacity (TPC-H q18: the
+# semi-joined lineitem frame is 6M lanes with ~500 live rows; stats
+# cannot see HAVING selectivity, the runtime can).  Reference analogue:
+# AdaptivePlanner re-optimizing from runtime stats.
+_COMPACT_MIN_SRC = 65536
+
+
+def insert_compaction(plan: PlanNode, catalogs) -> PlanNode:
+    """Insert (initially pass-through) Compact points above filters and
+    semi/anti membership tests over large frames.  Idempotent: re-running
+    over an already-compacted plan adds no second wrapper."""
+    from .nodes import Compact
+    from .stats import estimate
+
+    memo: dict[PlanNode, float] = {}
+
+    def child_rows(n: PlanNode) -> float:
+        # estimate() is unmemoized by design ("memoization is the caller's
+        # concern"); without this cache the pass is O(n^2) in plan depth
+        hit = memo.get(n)
+        if hit is None:
+            try:
+                hit = max(estimate(n, catalogs).rows, 1.0)
+            except Exception:
+                hit = 1.0
+            memo[n] = hit
+        return hit
+
+    def visit(node: PlanNode) -> PlanNode:
+        if isinstance(node, Compact):
+            inner = visit(node.child)
+            return inner if isinstance(inner, Compact) else Compact(inner)
+        kids = node.children
+        if kids:
+            new_kids = tuple(visit(c) for c in kids)
+            if new_kids != kids:
+                node = _replace_kids(node, new_kids)
+        wrap = False
+        if isinstance(node, Filter):
+            wrap = child_rows(node.child) >= _COMPACT_MIN_SRC
+        elif isinstance(node, Join) and node.kind in (
+            "semi", "anti", "null_anti"
+        ):
+            wrap = child_rows(node.left) >= _COMPACT_MIN_SRC
+        if wrap:
+            return Compact(node)
+        return node
+
+    return visit(plan)
+
+
+def _replace_kids(node: PlanNode, kids):
+    import dataclasses
+
+    from .nodes import Concat, Join
+
+    if isinstance(node, Join):
+        return dataclasses.replace(node, left=kids[0], right=kids[1])
+    if isinstance(node, Concat):
+        return dataclasses.replace(node, inputs=kids)
+    return dataclasses.replace(node, child=kids[0])
 
 
 def push_filters(plan: PlanNode) -> PlanNode:
@@ -341,6 +413,12 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, dict[int, int]]:
         for pos, i in enumerate(keep_calls):
             mapping[nc + i] = new_nc + pos
         return new, mapping
+
+    from .nodes import Compact as _Compact
+
+    if isinstance(node, _Compact):
+        child, m = _prune(node.child, needed)
+        return _Compact(child), m
 
     from .nodes import MatchRecognize as _MR
 

@@ -24,6 +24,7 @@ from typing import Optional
 from ..connectors.spi import CatalogManager, ColumnStats
 from .ir import Call, Const, FieldRef, InListIr, IrExpr, LikeIr
 from .nodes import (
+    Compact,
     Aggregate, Concat, Distinct, Exchange, Filter, Join, Limit, PlanNode,
     Project, RemoteSource, Sort, TableScan, TopN, Values, Window,
 )
@@ -60,6 +61,9 @@ def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:
             return PlanStats(ts.row_count, cols)
         n = conn.estimated_row_count(node.table)
         return PlanStats(float(n) if n is not None else _DEFAULT_ROWS, {})
+
+    if isinstance(node, Compact):
+        return estimate(node.child, catalogs)
 
     if isinstance(node, Filter):
         child = estimate(node.child, catalogs)

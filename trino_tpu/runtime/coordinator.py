@@ -3,10 +3,11 @@
 Reference wiring this replaces (SURVEY §3.1-3.2):
   - discovery/membership + heartbeat failure detector
     (node/CoordinatorNodeManager, failuredetector/HeartbeatFailureDetector.java:76)
-  - stage scheduling: fragments run children-first, one task per worker per
-    stage, splits assigned round-robin
-    (execution/scheduler/PipelinedQueryScheduler.java:164 — here stage-by-
-    stage like the FTE scheduler rather than pipelined)
+  - stage scheduling: ALL-AT-ONCE posts every stage up front (workers
+    long-poll their sources, so stages pipeline); PHASED (retry_policy=
+    TASK) runs dependency waves with independent sibling subtrees
+    CONCURRENT (execution/scheduler/PipelinedQueryScheduler.java:164 +
+    scheduler/policy/PhasedExecutionSchedule.java)
   - client protocol: POST /v1/statement, poll GET nextUri
     (dispatcher/QueuedStatementResource.java:109, server/protocol/
     ExecutingStatementResource.java), results paged from the root stage
