@@ -145,12 +145,28 @@ def _chunked_f32_segment_sum(values: jnp.ndarray, seg: jnp.ndarray, num: int):
     return partial.astype(jnp.float64).sum(axis=0)
 
 
+def _sortable_operands(v: ColumnVal, descending: bool = False) -> list:
+    """Sort operand list for one key: one array for single-lane columns,
+    TWO for decimal128 (lexicographic (hi signed, lo unsigned) == 128-bit
+    numeric order; descending negates at 128-bit width first)."""
+    if v.data2 is not None:
+        from ..data.dec128 import neg128
+
+        lo = v.data.astype(jnp.int64)
+        hi = v.data2.astype(jnp.int64)
+        if descending:
+            lo, hi = neg128(lo, hi)
+        lo_u = jax.lax.bitcast_convert_type(lo, jnp.uint64)
+        return [hi, lo_u]
+    return [_sortable_key(v, descending)]
+
+
 def _sortable_key(v: ColumnVal, descending: bool = False) -> jnp.ndarray:
     """Lower a column to a sortable numeric array (varchar -> dictionary rank,
     bool -> int8); negated for descending order."""
     if v.data2 is not None:
         raise NotImplementedError(
-            "decimal128 lanes as sort/group/join keys (two-limb keys)"
+            "decimal128 lanes in this operation (two-limb keys)"
         )
     data = v.data
     if v.dict is not None:
@@ -214,15 +230,16 @@ def group_aggregate(
         operands: list[jnp.ndarray] = [(~live).astype(jnp.int8)]
         for kv in key_vals:
             operands.append(~_valid_of(kv, n))  # nulls group together (last)
-            operands.append(_sortable_key(kv))
+            operands.extend(_sortable_operands(kv))  # 2 ops for decimal128
+        n_key_ops = len(operands) - 1
         if extra is not None:
             operands.append((~_valid_of(extra, n)).astype(jnp.int8))
-            operands.append(_sortable_key(extra))
+            operands.extend(_sortable_operands(extra))
         iota = jnp.arange(n, dtype=jnp.int32)
         sorted_ops = jax.lax.sort(operands + [iota], num_keys=len(operands))
         perm = sorted_ops[-1]
         live_s = jnp.take(live, perm)
-        key_ops = sorted_ops[1 : 1 + 2 * len(key_vals)]
+        key_ops = sorted_ops[1 : 1 + n_key_ops]
         diff = jnp.zeros((n,), jnp.bool_)
         for op in key_ops:
             prev = jnp.concatenate([op[:1], op[:-1]])
@@ -1213,7 +1230,7 @@ def sort_rows(
         # smaller flag sorts first: nulls-first -> nulls get 0, else nulls get 1
         null_flag = valid if spec.nulls_first else ~valid
         operands.append(null_flag.astype(jnp.int8))
-        operands.append(_sortable_key(kv, descending=not spec.ascending))
+        operands.extend(_sortable_operands(kv, descending=not spec.ascending))
     iota = jnp.arange(n, dtype=jnp.int32)
     sorted_ops = jax.lax.sort(operands + [iota], num_keys=len(operands), is_stable=True)
     perm = sorted_ops[-1]
@@ -1244,7 +1261,11 @@ def top_n(cols, live, keys, specs, count: int, cap: Optional[int] = None):
     n = live.shape[0]
     from .pallas.topk import radix_topk_supported, radix_topk_threshold, sortable_u32
 
-    if cap is not None and cap >= count and keys and radix_topk_supported(n, count):
+    if (
+        cap is not None and cap >= count and keys
+        and keys[0].data2 is None  # radix threshold is 32-bit single-lane
+        and radix_topk_supported(n, count)
+    ):
         kv, spec = keys[0], specs[0]
         valid = _valid_of(kv, n)
         u = sortable_u32(_sortable_key(kv), descending=False)
