@@ -20,7 +20,22 @@
 #include <cstdint>
 #include <cstring>
 #include <vector>
+
+#if defined(__has_include) && __has_include(<zstd.h>)
 #include <zstd.h>
+#else
+// No dev headers on this host: declare the minimal ZSTD surface ourselves
+// and resolve it at load time from the system runtime (libzstd.so.1).  The
+// simple-API ABI is stable across every zstd 1.x release.
+extern "C" {
+size_t ZSTD_compressBound(size_t srcSize);
+size_t ZSTD_compress(void *dst, size_t dstCapacity, const void *src,
+                     size_t srcSize, int compressionLevel);
+size_t ZSTD_decompress(void *dst, size_t dstCapacity, const void *src,
+                       size_t compressedSize);
+unsigned ZSTD_isError(size_t code);
+}
+#endif
 
 namespace {
 

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Chaos tier: TPC-H under seeded random fault schedules (ERROR / TIMEOUT /
+# SLOW / EXCHANGE_DROP) on a retry_policy=TASK cluster, diffed against the
+# sqlite oracle.  Deterministic: a failing schedule replays from its seed
+# (tests/test_chaos.py::SEED).
+#
+# Not part of the tier-1 gate (marked slow); run it before touching the
+# runtime/ or parallel/ layers.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider "$@"

@@ -75,6 +75,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: requires real TPU hardware (skipped on CPU-only hosts)"
     )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (`-m 'not slow'`)"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: randomized-fault resilience tier; run with scripts/chaos_tier.sh",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

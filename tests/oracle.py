@@ -343,6 +343,15 @@ class SqliteOracle:
             rows = list(zip(*arrays))
             ph = ", ".join("?" for _ in cols)
             self.conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)
+            # index join keys: without these, OR-of-conjunct queries like
+            # TPC-DS q48 send sqlite's planner into an unindexed nested loop
+            # that runs for minutes even at tiny scale
+            for c in cols:
+                if c.endswith("_sk") or c.endswith("key"):
+                    self.conn.execute(
+                        f"CREATE INDEX IF NOT EXISTS idx_{name}_{c} ON {name} ({c})"
+                    )
+        self.conn.execute("ANALYZE")
         self.conn.commit()
 
     def query(self, sql: str) -> list[tuple]:

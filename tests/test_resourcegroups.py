@@ -185,7 +185,9 @@ def test_weighted_fair_sibling_scheduling():
 def test_cluster_memory_kill_biggest_query(tpch_tiny):
     """Cluster memory enforcement: when worker-reported buffered bytes
     exceed the cluster limit, the coordinator kills the query holding the
-    most (reference: ClusterMemoryManager + TotalReservation LowMemoryKiller)."""
+    most (reference: ClusterMemoryManager + TotalReservation LowMemoryKiller)
+    — then degrades gracefully: the killed query is requeued through the
+    out-of-core spill executor and still returns correct rows."""
     import threading
     import time
 
@@ -243,11 +245,15 @@ def test_cluster_memory_kill_biggest_query(tpch_tiny):
         assert runner.coordinator.memory_kills > 0, "no memory kill happened"
         conn.gate.set()
         sm = runner.coordinator.queries[qid]["sm"]
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while sm.state not in ("FINISHED", "FAILED") and time.monotonic() < deadline:
             time.sleep(0.1)
-        assert sm.state == "FAILED", sm.state
-        assert "cluster memory limit" in (sm.error or ""), sm.error
+        # graceful degradation: the kill requeues through the out-of-core
+        # executor instead of surfacing a failure
+        assert sm.state == "FINISHED", f"{sm.state}: {sm.error}"
+        assert runner.coordinator.memory_requeues > 0
+        expect = int((np.arange(1000) + (np.arange(1000) % 500)).sum())
+        assert runner.coordinator.queries[qid]["result"] == [(expect,)]
     finally:
         conn.gate.set()
         runner.stop()

@@ -93,7 +93,13 @@ def compute_table_stats(data: dict, max_ndv_rows: int = _NDV_SAMPLE_ROWS) -> Tab
                 take = samples.get(len(base))
                 if take is None:
                     rng = np.random.default_rng(0xD5)
-                    take = rng.integers(0, len(base), _NDV_SAMPLE_ROWS)
+                    # GEE assumes a without-replacement sample; duplicates
+                    # from with-replacement draws deflate f1 and bias NDV low
+                    take = rng.choice(
+                        len(base),
+                        min(_NDV_SAMPLE_ROWS, len(base)),
+                        replace=False,
+                    )
                     samples[len(base)] = take
                 ndv = _estimate_ndv(base[take], len(base))
         if len(base) and base.dtype != object and np.issubdtype(base.dtype, np.number):

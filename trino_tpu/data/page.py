@@ -337,15 +337,21 @@ class Page:
             elif col.type.is_floating:
                 pys.append(data.astype(float))
             elif col.type.is_decimal:
-                if data2 is not None:
-                    # limbed decimal128: exact python Decimal surface
+                if col.type.precision > 18:
+                    # long decimal: exact python Decimal surface whether or
+                    # not the magnitude forced a second limb — one client
+                    # type per SQL type, not per runtime representation
                     from decimal import Decimal
 
                     from .dec128 import combine_py
 
                     vals = np.empty(len(data), dtype=object)
                     for i in range(len(data)):
-                        unscaled = combine_py(int(data2[i]), int(data[i]))
+                        unscaled = (
+                            combine_py(int(data2[i]), int(data[i]))
+                            if data2 is not None
+                            else int(data[i])
+                        )
                         vals[i] = (
                             Decimal(unscaled).scaleb(-col.type.scale)
                             if col.type.scale else Decimal(unscaled)

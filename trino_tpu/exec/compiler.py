@@ -631,7 +631,10 @@ def _trace_plan(
             ):
                 counter[0] += len(_node_ids(node))
                 return _Stage(
-                    [ColumnVal(cv.data, cv.valid, cv.dict, cv.type) for cv in stage_c.cols],
+                    [
+                        ColumnVal(cv.data, cv.valid, cv.dict, cv.type, cv.data2)
+                        for cv in stage_c.cols
+                    ],
                     stage_c.live,
                 )
         stage = _emit(node)
@@ -706,7 +709,10 @@ def _trace_plan(
                 None if a.arg2 is None else eval_expr(a.arg2, s.cols, s.capacity)
                 for a in node.aggs
             ]
-            specs = [AggSpec(a.fn, a.distinct, a.param, a.sep) for a in node.aggs]
+            specs = [
+                AggSpec(a.fn, a.distinct, a.param, a.sep, a.type)
+                for a in node.aggs
+            ]
             aorder = [
                 tuple(
                     (eval_expr(k, s.cols, s.capacity), asc, nf)
@@ -719,8 +725,10 @@ def _trace_plan(
             )
             report(nid, n_groups)
             cols: list[ColumnVal] = []
-            for (data, valid), kv in zip(out_keys, keys):
-                cols.append(ColumnVal(data, _none_if_all(valid), kv.dict, kv.type))
+            for (data, valid, khi), kv in zip(out_keys, keys):
+                cols.append(
+                    ColumnVal(data, _none_if_all(valid), kv.dict, kv.type, khi)
+                )
             for out, a, arg in zip(out_aggs, node.aggs, args):
                 hi = None
                 if len(out) == 4:  # decimal128 sum: (lo, valid, None, hi)
@@ -741,8 +749,8 @@ def _trace_plan(
             )
             report(nid, n_groups)
             cols = [
-                ColumnVal(data, _none_if_all(valid), cv.dict, cv.type)
-                for (data, valid), cv in zip(out_keys, s.cols)
+                ColumnVal(data, _none_if_all(valid), cv.dict, cv.type, khi)
+                for (data, valid, khi), cv in zip(out_keys, s.cols)
             ]
             return _Stage(cols, out_live)
 
@@ -830,7 +838,7 @@ def _trace_plan(
             return _Stage(cols, live)
 
         if isinstance(node, Exchange):
-            s = check_limbed(emit(node.child), "exchange")
+            s = emit(node.child)  # limbed columns ride the collectives (data2)
             if node.kind == "single":
                 # replicated input that must count once: keep device 0's copy
                 if axis is not None:

@@ -28,14 +28,36 @@ _SRC = os.path.join(_REPO_ROOT, "native", "pageserde.cpp")
 _SO = os.path.join(_REPO_ROOT, "native", "libpageserde.so")
 
 
+def _zstd_runtime() -> Optional[str]:
+    """Locate the system zstd RUNTIME library (libzstd.so.1) for hosts with
+    no dev package: g++ happily links against the versioned .so directly."""
+    import glob
+
+    for pat in (
+        "/usr/lib/*/libzstd.so*",
+        "/usr/lib/libzstd.so*",
+        "/usr/local/lib/libzstd.so*",
+        "/lib/*/libzstd.so*",
+    ):
+        hits = sorted(glob.glob(pat))
+        if hits:
+            return hits[0]
+    return None
+
+
 def _build() -> Optional[ctypes.CDLL]:
     try:
         if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            subprocess.run(
-                ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC,
-                 "-o", _SO, "-lzstd"],
-                check=True, capture_output=True,
-            )
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO]
+            try:
+                subprocess.run(cmd + ["-lzstd"], check=True, capture_output=True)
+            except Exception:
+                # no -dev package (no libzstd.so linker symlink): link the
+                # versioned runtime library directly
+                rt = _zstd_runtime()
+                if rt is None:
+                    raise
+                subprocess.run(cmd + [rt], check=True, capture_output=True)
         lib = ctypes.CDLL(_SO)
     except Exception:
         return None
@@ -70,11 +92,19 @@ class PageSerde:
     def __init__(self, level: int = 3):
         self.level = level
         self._lib = _build()
+        self._codec = 0  # 1 = zstd (zstandard module), 2 = zlib (stdlib)
         if self._lib is None:  # python fallback
-            import zstandard
+            try:
+                import zstandard
 
-            self._zc = zstandard.ZstdCompressor(level=level)
-            self._zd = zstandard.ZstdDecompressor()
+                self._zc = zstandard.ZstdCompressor(level=level)
+                self._zd = zstandard.ZstdDecompressor()
+                self._codec = 1
+            except ImportError:
+                # last-resort degradation: stdlib zlib — worse ratio/speed,
+                # but the engine keeps running with no compiler, no zstd
+                # headers, and no zstandard wheel
+                self._codec = 2
 
     @property
     def native(self) -> bool:
@@ -93,14 +123,22 @@ class PageSerde:
             if n < 0:
                 raise RuntimeError("page serialization failed")
             return out.raw[:n]
-        # fallback: simple python framing
+        # fallback: simple python framing; the codec byte records which
+        # compressor produced each column so deserialize needs no config
         import struct
 
         parts = [struct.pack("<IIQ", 0x54505047, len(buffers), nrows)]
         for b in buffers:
-            z = self._zc.compress(b)
+            if self._codec == 1:
+                z = self._zc.compress(b)
+            else:
+                import zlib
+
+                z = zlib.compress(b, 6)
             use = z if len(z) < len(b) else b
-            parts.append(struct.pack("<BQQ", int(use is z), len(b), len(use)))
+            parts.append(
+                struct.pack("<BQQ", self._codec if use is z else 0, len(b), len(use))
+            )
             parts.append(use)
         return b"".join(parts)
 
@@ -135,7 +173,16 @@ class PageSerde:
             off += 17
             blob = data[off : off + payload]
             off += payload
-            out.append(self._zd.decompress(blob, max_output_size=raw) if comp else blob)
+            if comp == 0:
+                out.append(blob)
+            elif comp == 1:
+                out.append(self._zd.decompress(blob, max_output_size=raw))
+            elif comp == 2:
+                import zlib
+
+                out.append(zlib.decompress(blob))
+            else:
+                raise RuntimeError(f"unknown page codec: {comp}")
         return out, nrows_
 
     # ---- column <-> buffer mapping ----------------------------------------

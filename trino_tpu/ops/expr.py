@@ -241,10 +241,10 @@ def _call(e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
                "mul", "div", "mod")
         and any(v.data2 is not None for v in args)
     ) or (
-        # single-lane decimal product whose RESULT type exceeds int64
+        # single-lane decimal arithmetic whose RESULT type exceeds int64
         # digits: compute at 128-bit width rather than silently wrapping
-        # the int64 lanes (reference: Int128Math.multiply)
-        op == "mul"
+        # the int64 lanes (reference: Int128Math.multiply / add)
+        op in ("add", "sub", "mul")
         and e.type.is_decimal
         and e.type.precision > 18
         and all(v.type is not None and v.type.is_decimal for v in args)

@@ -44,6 +44,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# jax moved enable_x64 between releases: public on new jax, experimental on
+# 0.4.x. Resolve once at import so the kernel call site stays version-agnostic.
+_enable_x64 = getattr(jax, "enable_x64", None)
+if _enable_x64 is None:  # pragma: no cover - depends on installed jax
+    from jax.experimental import enable_x64 as _enable_x64
+
 __all__ = ["SegRed", "fused_segment_reduce", "pallas_segreduce_supported"]
 
 _CHUNK_S = 8  # sublanes per row-chunk
@@ -446,7 +452,7 @@ def fused_segment_reduce(
         # Mosaic requires i32 grid indices; under the engine's global x64 mode
         # the BlockSpec index maps trace to i64 and fail to legalize.  All
         # kernel operands/outputs are f32/i32, so scoped-disabling x64 is sound.
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             results = call(*args)
         if not isinstance(results, (tuple, list)):
             results = (results,)

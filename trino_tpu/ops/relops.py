@@ -48,6 +48,9 @@ class AggSpec:
     distinct: bool = False
     param: Optional[float] = None  # percentile's p
     sep: Optional[str] = None  # listagg separator
+    type: Optional[object] = None  # result SqlType (decimal SUMs with
+    #          precision > 18 accumulate in two-limb int128 even when the
+    #          input column is single-lane; without it int64 wraps silently)
 
 
 # aggregates computed on the HOST over the sorted grouping (their outputs
@@ -192,10 +195,11 @@ def group_aggregate(
 ):
     """Sort-based grouped aggregation.
 
-    Returns (out_keys: list[(data, valid)], out_aggs: list[(data, valid)
-    or (data, valid, Dictionary) for host-collected aggregates], out_live,
-    n_groups) where outputs have capacity `num_groups_cap` and n_groups is
-    the true group count (> cap == overflow, host retries).
+    Returns (out_keys: list[(data, valid, data2-or-None)], out_aggs:
+    list[(data, valid) or (data, valid, Dictionary) for host-collected
+    aggregates], out_live, n_groups) where outputs have capacity
+    `num_groups_cap` and n_groups is the true group count (> cap ==
+    overflow, host retries).
     """
     n = live.shape[0]
     G = num_groups_cap
@@ -266,12 +270,15 @@ def group_aggregate(
     starts = searchsorted_tpu(seg32, gids, side="left")
     ends = searchsorted_tpu(seg32, gids, side="right")
     starts_i = jnp.clip(starts, 0, max(n - 1, 0))
-    out_keys: list[tuple[jnp.ndarray, Optional[jnp.ndarray]]] = []
+    out_keys: list[tuple] = []
     for kv in key_vals:
         data_s = jnp.take(kv.data, perm)
         valid_s = jnp.take(_valid_of(kv, n), perm)
+        hi = None
+        if kv.data2 is not None:  # decimal128 keys: carry the high limb
+            hi = jnp.take(jnp.take(kv.data2, perm), starts_i)
         out_keys.append(
-            (jnp.take(data_s, starts_i), jnp.take(valid_s, starts_i))
+            (jnp.take(data_s, starts_i), jnp.take(valid_s, starts_i), hi)
         )
 
     # ---- aggregates -------------------------------------------------------
@@ -352,7 +359,7 @@ def _direct_code_aggregate(key_vals, agg_args, specs, live, agg_args2=None):
         rem = rem // d
     codes_per_key.reverse()
     for kv, codes in zip(key_vals, codes_per_key):
-        out_keys.append((jnp.asarray(codes.astype(np.int32)), None))
+        out_keys.append((jnp.asarray(codes.astype(np.int32)), None, None))
 
     out_aggs = _fused_aggs(agg_args, specs, None, seg, live, G, n, agg_args2=agg_args2)
     return out_keys, out_aggs, out_live, n_groups
@@ -438,16 +445,30 @@ def _fused_aggs(
         if perm is not None:
             valid = jnp.take(valid, perm)
         valid = valid & live_s
+        res_t = spec.type
+        wide_sum = (
+            spec.fn == "sum"
+            and res_t is not None
+            and getattr(res_t, "is_decimal", False)
+            and res_t.precision > 18
+            and jnp.issubdtype(data.dtype, jnp.integer)
+        )
         if spec.fn == "count":
             recipe.append(("count", add_count(valid)))
-        elif arg.data2 is not None and spec.fn == "sum":
+        elif spec.fn == "sum" and (arg.data2 is not None or wide_sum):
             # decimal128 sum: four 32-bit limb sums (each exact in int64 for
             # n < 2^31 rows) recombined into two-limb outputs (the segreduce
-            # analogue of Int128Math.addWithOverflow accumulation)
+            # analogue of Int128Math.addWithOverflow accumulation).  Also
+            # taken when the RESULT precision > 18 over a single-lane input:
+            # the int64 inputs fit, but their sum can overflow int64.
             from ..data.dec128 import limbs32
 
-            hi = arg.data2 if perm is None else jnp.take(arg.data2, perm)
-            l0, l1, l2, l3 = limbs32(data.astype(jnp.int64), hi)
+            lo64 = data.astype(jnp.int64)
+            if arg.data2 is not None:
+                hi = arg.data2 if perm is None else jnp.take(arg.data2, perm)
+            else:
+                hi = lo64 >> 63  # sign-extend the single lane
+            l0, l1, l2, l3 = limbs32(lo64, hi)
             recipe.append(
                 ("sum128", add(SegRed("sum", l0, valid)),
                  add(SegRed("sum", l1, valid)), add(SegRed("sum", l2, valid)),
@@ -962,6 +983,16 @@ def _combined_hash(keys: Sequence[ColumnVal], live: jnp.ndarray, n: int, sentine
                 bits = jax.lax.bitcast_convert_type(bits.astype(jnp.float64), jnp.uint64)
             else:
                 bits = bits.astype(jnp.int64).astype(jnp.uint64)
+            if kv.data2 is not None:
+                # Values that fit in int64 carry a sign-extension high limb;
+                # mix hi only when it adds information so limbed and
+                # non-limbed representations of the same value hash alike.
+                lo = kv.data.astype(jnp.int64)
+                hi = kv.data2.astype(jnp.int64)
+                extra = jnp.where(
+                    hi == (lo >> 63), jnp.uint64(0), _mix64(hi.astype(jnp.uint64))
+                )
+                bits = bits ^ extra
         h = _mix64(h ^ _mix64(bits))
         ok = ok & _valid_of(kv, n)
     h = (h & jnp.uint64(0x3FFF_FFFF_FFFF_FFFF)).astype(jnp.int64)

@@ -39,7 +39,8 @@ def gather_all(cols: Sequence[ColumnVal], live: jnp.ndarray, axis: str = AXIS):
     for cv in cols:
         data = _flatten_gather(cv.data, axis)
         valid = None if cv.valid is None else _flatten_gather(cv.valid, axis)
-        out_cols.append(ColumnVal(data, valid, cv.dict, cv.type))
+        data2 = None if cv.data2 is None else _flatten_gather(cv.data2, axis)
+        out_cols.append(ColumnVal(data, valid, cv.dict, cv.type, data2))
     return out_cols, _flatten_gather(live, axis)
 
 
@@ -97,16 +98,15 @@ def repartition(
     recv_live = jax.lax.all_to_all(sent_live, axis, split_axis=0, concat_axis=0)
     out_live = recv_live.reshape(-1)
 
+    def route(x: jnp.ndarray) -> jnp.ndarray:
+        sent = to_buckets(jnp.take(x, perm))
+        recv = jax.lax.all_to_all(sent, axis, split_axis=0, concat_axis=0)
+        return recv.reshape((-1,) + recv.shape[2:])
+
     out_cols = []
     for cv in cols:
-        sent = to_buckets(jnp.take(cv.data, perm))
-        recv = jax.lax.all_to_all(sent, axis, split_axis=0, concat_axis=0)
-        data = recv.reshape((-1,) + recv.shape[2:])
-        if cv.valid is None:
-            valid = None
-        else:
-            sv = to_buckets(jnp.take(cv.valid, perm))
-            rv = jax.lax.all_to_all(sv, axis, split_axis=0, concat_axis=0)
-            valid = rv.reshape(-1)
-        out_cols.append(ColumnVal(data, valid, cv.dict, cv.type))
+        data = route(cv.data)
+        valid = None if cv.valid is None else route(cv.valid)
+        data2 = None if cv.data2 is None else route(cv.data2)
+        out_cols.append(ColumnVal(data, valid, cv.dict, cv.type, data2))
     return out_cols, out_live, required

@@ -38,12 +38,27 @@ from ..plan.fragmenter import Fragment, fragment_plan
 from ..plan.optimizer import optimize
 from ..plan.planner import Planner
 from ..plan.serde import _encode, plan_to_json
+from .failure import Backoff, FailureDetector
 from .session import SessionProperties
 from .spool import SPOOL_URL, SpooledExchange
 from .statemachine import QueryStateMachine
 from .wire import wire_to_page
 
 __all__ = ["Coordinator"]
+
+
+def _json_default(o):
+    """Result rows can hold decimal.Decimal (long-decimal Python surface,
+    data/page.py to_pylist): the HTTP protocol and spooled segments send
+    them as strings — exact digits, like the reference client protocol's
+    text encoding of decimals."""
+    from decimal import Decimal
+
+    if isinstance(o, Decimal):
+        return str(o)
+    raise TypeError(
+        f"Object of type {type(o).__name__} is not JSON serializable"
+    )
 
 
 class _WorkerInfo:
@@ -77,8 +92,18 @@ class Coordinator:
         # MemoryInfo and OOM-kills the biggest reservation under pressure
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.memory_kills = 0  # observability
+        self.memory_requeues = 0  # memory kills degraded to out-of-core
         self._lock = threading.Lock()
         self.heartbeat_interval = heartbeat_interval
+        # per-worker circuit breaker fed by heartbeat outcomes (reference:
+        # HeartbeatFailureDetector.java:76); quarantined workers receive no
+        # new dispatches and are half-open probed for automatic recovery
+        self.failure_detector = FailureDetector(
+            probe_interval=heartbeat_interval * 2
+        )
+        # finished queries older than this are expired (record + spooled
+        # segments GC'd) by the heartbeat sweep; 0 disables
+        self.query_expiration_seconds = 900.0
         self._hb_stop = threading.Event()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -102,32 +127,44 @@ class Coordinator:
     def register_worker(self, url: str) -> None:
         with self._lock:
             self.workers[url] = _WorkerInfo(url)
+        # a re-announcing worker (restart) starts with a clean bill of health
+        self.failure_detector.reset(url)
 
     def alive_workers(self) -> list[str]:
         with self._lock:
             return [w.url for w in self.workers.values() if w.alive]
 
     def _heartbeat_loop(self) -> None:
-        """Decayed-failure heartbeat gating (HeartbeatFailureDetector.java:76
-        reduced to consecutive-failure gating)."""
+        """Heartbeat-driven failure detection (HeartbeatFailureDetector.
+        java:76): each sweep probes workers, feeds latency/error outcomes
+        into the EWMA circuit breaker, and derives dispatchability from its
+        state.  QUARANTINED workers are skipped until their half-open
+        window opens; one successful probe restores them.  The sweep also
+        expires old finished queries (age-based spool GC)."""
+        det = self.failure_detector
         while not self._hb_stop.wait(self.heartbeat_interval):
             with self._lock:
                 infos = list(self.workers.values())
             cluster_by_query: dict[str, int] = {}
             for w in infos:
+                if not det.should_probe(w.url):
+                    w.alive = False  # quarantined, half-open window closed
+                    continue
+                t0 = time.monotonic()
                 try:
                     with urllib.request.urlopen(f"{w.url}/v1/info", timeout=2) as r:
                         info = json.loads(r.read())
-                    w.alive = True
+                    det.record_success(w.url, time.monotonic() - t0)
                     w.failures = 0
                     w.last_seen = time.time()
                     for qid, b in (info.get("buffered_by_query") or {}).items():
                         cluster_by_query[qid] = cluster_by_query.get(qid, 0) + int(b)
                 except Exception:
                     w.failures += 1
-                    if w.failures >= 2:
-                        w.alive = False
+                    det.record_failure(w.url)
+                w.alive = det.is_dispatchable(w.url)
             self._enforce_cluster_memory(cluster_by_query)
+            self._expire_old_queries()
 
     def _enforce_cluster_memory(self, by_query: dict[str, int]) -> None:
         """Kill the biggest reservation when the cluster exceeds its memory
@@ -145,9 +182,37 @@ class Coordinator:
                 f"Query killed: cluster memory limit {limit} bytes exceeded "
                 f"(query held {_bytes} buffered bytes)"
             )
+            # graceful degradation: instead of failing outright, the kill is
+            # requeued through the out-of-core spill executor (exec/spill.py)
+            # — sequential slices with disk exchanges need a fraction of the
+            # distributed working set (the reference fails the query;
+            # TASK-retried FTE queries get bigger nodes — our analogue is a
+            # smaller-footprint execution mode)
+            record["requeue_spill"] = True
             record["cancel"] = True
             self.memory_kills += 1
             return  # one victim per sweep; re-evaluate next heartbeat
+
+    def _expire_old_queries(self) -> None:
+        """Age-based expiry of finished queries (reference: QueryTracker.
+        pruneExpiredQueries): the record and any spooled result segments
+        are dropped once `query_expiration_seconds` passed since the query
+        reached a terminal state.  Candidates are collected under the lock;
+        expiry runs outside it (expire_query re-locks)."""
+        max_age = self.query_expiration_seconds
+        if not max_age:
+            return
+        now = time.time()
+        with self._lock:
+            expired = [
+                qid
+                for qid, rec in self.queries.items()
+                if rec["sm"].done
+                and rec["sm"].finished_at is not None
+                and now - rec["sm"].finished_at >= max_age
+            ]
+        for qid in expired:
+            self.expire_query(qid)
 
     # ------------------------------------------------------------ execution
     def execute_query(self, sql: str) -> list[tuple]:
@@ -278,9 +343,42 @@ class Coordinator:
             except Exception as e:
                 if attempt < retries:
                     continue  # query-level retry (RetryPolicy QUERY)
+                if record.pop("requeue_spill", None):
+                    # graceful degradation on a cluster-memory kill: instead
+                    # of failing, re-run through the out-of-core executor —
+                    # sequential slices with disk exchanges bound the peak
+                    # footprint, trading latency for completion
+                    record["cancel"] = False
+                    try:
+                        self._requeue_out_of_core(record)
+                        sm.transition("FINISHED")
+                        return
+                    except Exception as e2:
+                        traceback.print_exc()
+                        sm.fail(f"{e}; out-of-core requeue failed: {e2}")
+                        return
                 traceback.print_exc()
                 sm.fail(str(e))
                 return
+
+    def _requeue_out_of_core(self, record: dict) -> None:
+        """Re-run a memory-killed query coordinator-side with P sequential
+        slices and disk exchanges (reference: memory-revoking spill — the
+        cluster sheds load by degrading the biggest query, not killing it)."""
+        from ..exec.spill import OutOfCoreExecutor
+
+        plan = optimize(self.planner.plan(record["sql"]), self.catalogs, self.session)
+        ex = OutOfCoreExecutor(
+            self.catalogs,
+            self.default_catalog,
+            parts=4,
+            session=self.session,
+            spill_dir=self.session.get("exchange_spool_dir") or None,
+        )
+        page = ex.execute(plan)
+        record["columns"] = list(plan.output_names)
+        record["result"] = page.to_pylist()
+        self.memory_requeues += 1
 
     def _run_once(self, record: dict, attempt: int = 0) -> None:
         """One execution attempt.
@@ -458,6 +556,11 @@ class Coordinator:
                 max_attempts=int(self.session.get("task_retry_attempts")),
                 posted=all_tasks,  # every posted task gets cleaned up
                 refresh_sources=refresh_sources,
+                should_abort=lambda: (
+                    (record.get("kill_reason") or "Query was canceled")
+                    if record.get("cancel")
+                    else None
+                ),
             )
             task_urls[f.id] = urls
             stage_times[f.id] = (t0, time.perf_counter() - t_query0)
@@ -520,6 +623,10 @@ class Coordinator:
 
             root = frag_by_id[0]
             executor = LocalExecutor(self.catalogs, self.default_catalog)
+            if record.get("cancel"):  # e.g. memory kill during the stages
+                raise RuntimeError(
+                    record.get("kill_reason") or "Query was canceled"
+                )
             remote_pages: dict[int, Page] = {}
             for child_id in root.inputs:
                 child = frag_by_id[child_id]
@@ -575,7 +682,7 @@ class Coordinator:
             chunk = rows[i: i + self._SPOOL_SEGMENT_ROWS]
             path = os.path.join(d, f"{qid}_seg{len(segs)}.json")
             with open(path, "w") as f:
-                json.dump([list(r) for r in chunk], f)
+                json.dump([list(r) for r in chunk], f, default=_json_default)
             segs.append({"path": path, "count": len(chunk)})
         record["segments"] = segs
         record["result"] = []  # rows live on disk, not in RAM
@@ -630,13 +737,17 @@ class Coordinator:
         max_attempts: int = 3,
         posted: Optional[list] = None,
         refresh_sources=None,
+        should_abort=None,
     ) -> list[tuple[str, str]]:
         """Post one stage's tasks, poll statuses, and re-schedule individual
         failures onto other alive workers (task-level recovery).  Every
         posted (worker, task_id) is appended to `posted` so cleanup covers
         failed stages too.  refresh_sources() is called before each
         re-schedule: it heals dead SOURCE producers and returns the updated
-        sources payload, so a retry doesn't re-fetch from a dead URL."""
+        sources payload, so a retry doesn't re-fetch from a dead URL.
+        should_abort() is checked between poll rounds: a non-None message
+        aborts the stage mid-flight (cluster memory kill, client cancel) —
+        without it a cancellation would only be seen at stage boundaries."""
         workers = self.alive_workers()
         urls: list[Optional[tuple[str, str]]] = [None] * nparts
         attempts = [0] * nparts
@@ -659,6 +770,10 @@ class Coordinator:
             try_post(p, w, task_id)
             pending[p] = (w, task_id)
         while pending:
+            if should_abort is not None:
+                msg = should_abort()
+                if msg:
+                    raise RuntimeError(msg)
             done: list[int] = []
             with ThreadPoolExecutor(max_workers=max(len(pending), 1)) as pool:
                 futs = {
@@ -677,7 +792,17 @@ class Coordinator:
                             f"task {pending[p][1]} failed {attempts[p]} times"
                         )
                     bad_url = pending[p][0]
-                    alive = [w for w in self.alive_workers() if w != bad_url]
+                    if state == "UNREACHABLE":
+                        # feed the circuit breaker so repeated unreachability
+                        # quarantines the worker out of the dispatch pool
+                        self.failure_detector.record_failure(bad_url)
+                    alive = [
+                        w
+                        for w in self.alive_workers()
+                        if w != bad_url and self.failure_detector.is_dispatchable(w)
+                    ]
+                    if not alive:
+                        alive = [w for w in self.alive_workers() if w != bad_url]
                     if not alive:
                         alive = self.alive_workers()
                     if not alive:
@@ -729,13 +854,21 @@ class Coordinator:
         return "TIMEOUT"
 
     def _task_status(self, worker_url: str, task_id: str, wait: float) -> str:
-        try:
-            with urllib.request.urlopen(
-                f"{worker_url}/v1/task/{task_id}/status?wait={wait}", timeout=wait + 10
-            ) as r:
-                return json.loads(r.read()).get("state", "UNKNOWN")
-        except Exception:
-            return "UNREACHABLE"
+        # transient poll errors retry through a short Backoff before the
+        # caller sees UNREACHABLE (reference: ContinuousTaskStatusFetcher
+        # retries through Backoff before failRemotely)
+        backoff = Backoff(min_delay=0.05, max_delay=0.5, max_elapsed=2.0)
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"{worker_url}/v1/task/{task_id}/status?wait={wait}",
+                    timeout=wait + 10,
+                ) as r:
+                    return json.loads(r.read()).get("state", "UNKNOWN")
+            except Exception:
+                if backoff.failure():
+                    return "UNREACHABLE"
+                backoff.sleep()
 
     def _failure_detail(self, all_tasks, base_exc: Exception) -> str:
         """Sweep task statuses for the root cause of a fetch failure."""
@@ -888,7 +1021,7 @@ def _make_handler(coord: Coordinator):
             pass
 
         def _send_json(self, code: int, obj) -> None:
-            body = json.dumps(obj).encode()
+            body = json.dumps(obj, default=_json_default).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -1,0 +1,322 @@
+"""Failure-handling primitives for the multi-host data plane.
+
+Reference wiring this replaces (SURVEY §3.2):
+  - Backoff: jittered exponential retry schedule with a failure deadline
+    (airlift Backoff.java, driven by HttpPageBufferClient.java:355 and
+    ContinuousTaskStatusFetcher) — transient fetch errors retry with
+    growing delays; only a deadline's worth of consecutive failures
+    escalates to task failure.
+  - FailureDetector: per-worker health from heartbeat observations
+    (failuredetector/HeartbeatFailureDetector.java:76 keeps an
+    exponentially-decayed failure rate per node and gates scheduling).
+    Modeled as a circuit breaker: OK -> SUSPECT (elevated error EWMA) ->
+    QUARANTINED (no new dispatches), with automatic half-open probes —
+    a quarantined worker is re-probed after `probe_interval` and one
+    successful probe restores it.
+  - FaultInjector: the test-only fault matrix
+    (execution/FailureInjector.java:33): ERROR, TIMEOUT, SLOW(delay_ms)
+    and EXCHANGE_DROP(count) faults, one-shot / counted / probabilistic,
+    targeted at a task id, a task-id prefix, or every task ("*").
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = ["Backoff", "FailureDetector", "FaultInjector", "WorkerHealth"]
+
+
+class Backoff:
+    """Jittered exponential backoff with a failure deadline.
+
+    `failure()` records one failed attempt and returns True once the time
+    since the FIRST failure of the current streak exceeds `max_elapsed` —
+    the caller escalates (fails the task) instead of retrying forever.
+    `success()` resets the streak.  Delays grow min_delay * factor^k up to
+    max_delay, each multiplied by a random jitter in [1-jitter, 1+jitter]
+    (decorrelates retry storms across consumers hitting one producer).
+    """
+
+    def __init__(
+        self,
+        min_delay: float = 0.05,
+        max_delay: float = 2.0,
+        max_elapsed: float = 30.0,
+        factor: float = 2.0,
+        jitter: float = 0.25,
+        rng: Optional[random.Random] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        assert min_delay > 0 and max_delay >= min_delay and factor >= 1.0
+        assert 0.0 <= jitter < 1.0
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+        self.max_elapsed = max_elapsed
+        self.factor = factor
+        self.jitter = jitter
+        self._rng = rng or random.Random()
+        self._clock = clock
+        self._sleep = sleep
+        self.failure_count = 0
+        self.first_failure_at: Optional[float] = None
+
+    def failure(self) -> bool:
+        """Record a failed attempt; True == deadline exceeded, give up."""
+        now = self._clock()
+        if self.first_failure_at is None:
+            self.first_failure_at = now
+        self.failure_count += 1
+        return (now - self.first_failure_at) >= self.max_elapsed
+
+    def success(self) -> None:
+        self.failure_count = 0
+        self.first_failure_at = None
+
+    def delay(self) -> float:
+        """Delay before the next attempt, for the current failure count."""
+        k = max(self.failure_count - 1, 0)
+        base = min(self.min_delay * (self.factor ** k), self.max_delay)
+        if self.jitter:
+            base *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
+        return base
+
+    def sleep(self) -> None:
+        self._sleep(self.delay())
+
+
+# circuit-breaker states
+OK = "OK"
+SUSPECT = "SUSPECT"
+QUARANTINED = "QUARANTINED"
+
+
+@dataclass
+class WorkerHealth:
+    """Per-worker view the detector maintains from heartbeat outcomes."""
+
+    state: str = OK
+    error_ewma: float = 0.0  # decayed failure rate in [0, 1]
+    latency_ewma: float = 0.0  # decayed heartbeat latency (seconds)
+    consecutive_failures: int = 0
+    last_probe_at: float = field(default=0.0)
+    quarantined_at: Optional[float] = None
+
+
+class FailureDetector:
+    """EWMA heartbeat health + circuit breaker per worker.
+
+    Transitions (evaluated on every recorded observation):
+      OK         --failure-->                      SUSPECT
+      SUSPECT    --2nd consecutive failure or
+                   error_ewma >= quarantine_threshold--> QUARANTINED
+      SUSPECT    --success w/ error_ewma < suspect_threshold--> OK
+      QUARANTINED --successful half-open probe-->  OK
+
+    A QUARANTINED worker is not dispatchable; `should_probe` turns True
+    again `probe_interval` seconds after quarantine (half-open), letting
+    the heartbeat loop send one probe whose success restores the worker.
+    """
+
+    def __init__(
+        self,
+        alpha: float = 0.3,
+        suspect_threshold: float = 0.25,
+        quarantine_threshold: float = 0.75,
+        quarantine_failures: int = 2,
+        probe_interval: float = 4.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.alpha = alpha
+        self.suspect_threshold = suspect_threshold
+        self.quarantine_threshold = quarantine_threshold
+        self.quarantine_failures = quarantine_failures
+        self.probe_interval = probe_interval
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._workers: dict[str, WorkerHealth] = {}
+
+    def _get(self, url: str) -> WorkerHealth:
+        h = self._workers.get(url)
+        if h is None:
+            h = self._workers[url] = WorkerHealth()
+        return h
+
+    def reset(self, url: str) -> None:
+        """Forget a worker's history (re-announce after restart)."""
+        with self._lock:
+            self._workers[url] = WorkerHealth()
+
+    def record_success(self, url: str, latency: float = 0.0) -> None:
+        with self._lock:
+            h = self._get(url)
+            h.consecutive_failures = 0
+            h.error_ewma *= 1.0 - self.alpha
+            h.latency_ewma = (
+                latency
+                if h.latency_ewma == 0.0
+                else (1.0 - self.alpha) * h.latency_ewma + self.alpha * latency
+            )
+            h.last_probe_at = self._clock()
+            if h.state == QUARANTINED:
+                # half-open probe succeeded: full restore
+                h.state = OK
+                h.error_ewma = 0.0
+                h.quarantined_at = None
+            elif h.state == SUSPECT and h.error_ewma < self.suspect_threshold:
+                h.state = OK
+
+    def record_failure(self, url: str) -> None:
+        with self._lock:
+            h = self._get(url)
+            h.consecutive_failures += 1
+            h.error_ewma = (1.0 - self.alpha) * h.error_ewma + self.alpha
+            h.last_probe_at = self._clock()
+            if h.state == QUARANTINED:
+                # failed half-open probe: restart the quarantine clock
+                h.quarantined_at = self._clock()
+            elif (
+                h.consecutive_failures >= self.quarantine_failures
+                or h.error_ewma >= self.quarantine_threshold
+            ):
+                h.state = QUARANTINED
+                h.quarantined_at = self._clock()
+            elif h.state == OK:
+                h.state = SUSPECT
+
+    def state(self, url: str) -> str:
+        with self._lock:
+            return self._get(url).state
+
+    def is_dispatchable(self, url: str) -> bool:
+        """May this worker receive NEW task dispatches?  SUSPECT still may
+        (degraded but serving); QUARANTINED may not until a probe succeeds."""
+        with self._lock:
+            return self._get(url).state != QUARANTINED
+
+    def should_probe(self, url: str) -> bool:
+        """Should the heartbeat loop contact this worker this sweep?
+        Healthy workers: always.  Quarantined: only once the half-open
+        window opened (probe_interval since quarantine / last probe)."""
+        with self._lock:
+            h = self._get(url)
+            if h.state != QUARANTINED:
+                return True
+            anchor = max(h.quarantined_at or 0.0, h.last_probe_at)
+            return (self._clock() - anchor) >= self.probe_interval
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                url: {
+                    "state": h.state,
+                    "error_ewma": round(h.error_ewma, 4),
+                    "latency_ewma": round(h.latency_ewma, 6),
+                    "consecutive_failures": h.consecutive_failures,
+                }
+                for url, h in self._workers.items()
+            }
+
+
+# ------------------------------------------------------------ fault matrix
+
+
+@dataclass
+class _FaultRule:
+    task_id: str  # "*" == any; otherwise exact id or prefix
+    mode: str  # ERROR | TIMEOUT | SLOW | EXCHANGE_DROP
+    delay_ms: int = 0
+    count: int = 1  # firings remaining; <= 0 after exhaustion
+    probability: float = 1.0
+    rng: Optional[random.Random] = None
+
+    def matches(self, task_id: str) -> bool:
+        return self.task_id == "*" or task_id.startswith(self.task_id)
+
+
+class FaultInjector:
+    """The worker-side fault matrix (FailureInjector.java:33 analogue).
+
+    Rules are armed via POST /v1/inject_failure and consumed at two
+    hook points:
+      - task_fault(task_id): ERROR raises immediately, TIMEOUT sleeps
+        then raises (a slow failure that exercises status-deadline
+        escalation), SLOW sleeps then lets the task run normally.
+      - drop_fetch(task_id): EXCHANGE_DROP answers the next `count`
+        matching page-fetch requests with HTTP 503 — the consumer's
+        Backoff retries and resumes from its token, so recovery must be
+        idempotent.
+
+    `probability` < 1 arms a probabilistic variant: each match fires with
+    that probability using a per-rule seeded rng (deterministic chaos).
+    """
+
+    MODES = ("ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: list[_FaultRule] = []
+        self.fired: list[tuple[str, str]] = []  # (mode, task_id) observability
+
+    def arm(
+        self,
+        task_id: str = "*",
+        mode: str = "ERROR",
+        delay_ms: int = 0,
+        count: int = 1,
+        probability: float = 1.0,
+        seed: Optional[int] = None,
+    ) -> None:
+        mode = mode.upper()
+        if mode not in self.MODES:
+            raise ValueError(f"unknown fault mode: {mode}")
+        rule = _FaultRule(
+            task_id=task_id,
+            mode=mode,
+            delay_ms=int(delay_ms),
+            count=int(count),
+            probability=float(probability),
+            rng=random.Random(seed) if probability < 1.0 else None,
+        )
+        with self._lock:
+            self._rules.append(rule)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+
+    def _take(self, task_id: str, modes: tuple[str, ...]) -> Optional[_FaultRule]:
+        with self._lock:
+            for rule in self._rules:
+                if rule.mode not in modes or not rule.matches(task_id):
+                    continue
+                if rule.rng is not None and rule.rng.random() >= rule.probability:
+                    continue
+                rule.count -= 1
+                if rule.count <= 0:
+                    self._rules.remove(rule)
+                self.fired.append((rule.mode, task_id))
+                return rule
+        return None
+
+    def task_fault(self, task_id: str, sleep: Callable[[float], None] = time.sleep) -> None:
+        """Apply any armed ERROR/TIMEOUT/SLOW fault for this task.
+        Raises RuntimeError for ERROR/TIMEOUT; returns after the delay for
+        SLOW; no-op when nothing matches."""
+        rule = self._take(task_id, ("ERROR", "TIMEOUT", "SLOW"))
+        if rule is None:
+            return
+        if rule.mode == "ERROR":
+            raise RuntimeError(f"injected failure for task {task_id}")
+        if rule.delay_ms:
+            sleep(rule.delay_ms / 1000.0)
+        if rule.mode == "TIMEOUT":
+            raise RuntimeError(f"injected timeout for task {task_id}")
+
+    def drop_fetch(self, task_id: str) -> bool:
+        """True == answer this page-fetch request with a transient 503."""
+        return self._take(task_id, ("EXCHANGE_DROP",)) is not None

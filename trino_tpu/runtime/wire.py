@@ -27,19 +27,19 @@ __all__ = [
 CHUNK_ROWS = 262_144
 
 
-def _host_columns(page: Page) -> tuple[list[np.ndarray], list, np.ndarray]:
+def _host_columns(page: Page) -> tuple[list[np.ndarray], list, list, np.ndarray]:
     import jax
 
     # one batched device->host transfer (tunneled TPUs pay a network
     # round-trip per array otherwise; see data/page.py _fetch_host)
     fetched = jax.device_get(
-        [page.live_mask()] + [(c.data, c.valid) for c in page.columns]
+        [page.live_mask()] + [(c.data, c.valid, c.data2) for c in page.columns]
     )
     live = np.asarray(fetched[0])
     host = fetched[1:]
     idx = np.nonzero(live)[0]
-    datas, valids = [], []
-    for col, (hdata, hvalid) in zip(page.columns, host):
+    datas, valids, datas2 = [], [], []
+    for col, (hdata, hvalid, hdata2) in zip(page.columns, host):
         data = np.asarray(hdata)[idx]
         if col.type.is_array:
             # arrays cross the wire as JSON text (codes are process-local);
@@ -61,21 +61,27 @@ def _host_columns(page: Page) -> tuple[list[np.ndarray], list, np.ndarray]:
             )
         datas.append(data)
         valids.append(None if hvalid is None else np.asarray(hvalid)[idx])
-    return datas, valids, idx
+        datas2.append(
+            None if hdata2 is None else np.asarray(hdata2, np.int64)[idx]
+        )
+    return datas, valids, datas2, idx
 
 
 def page_to_wire(page: Page, row_mask: np.ndarray = None) -> bytes:
     """Serialize (optionally a row subset of) a page."""
-    datas, valids, idx = _host_columns(page)
+    datas, valids, datas2, idx = _host_columns(page)
     if row_mask is not None:
         keep = row_mask[: len(idx)] if len(row_mask) != len(idx) else row_mask
         datas = [d[keep] for d in datas]
         valids = [None if v is None else v[keep] for v in valids]
+        datas2 = [None if d2 is None else d2[keep] for d2 in datas2]
     cols: dict[str, np.ndarray] = {}
-    for i, (d, v) in enumerate(zip(datas, valids)):
+    for i, (d, v, d2) in enumerate(zip(datas, valids, datas2)):
         cols[f"c{i:04d}"] = d
         if v is not None:
             cols[f"v{i:04d}"] = v
+        if d2 is not None:
+            cols[f"d{i:04d}"] = d2
     return page_serde().serialize_columns(cols)
 
 
@@ -84,17 +90,19 @@ def page_to_wire_chunks(page: Page, chunk_rows: int = 0) -> list[bytes]:
     chunks of <= chunk_rows live rows each (token-addressed by index in the
     output buffer protocol; reference: PartitionedOutputBuffer pages)."""
     chunk_rows = chunk_rows or CHUNK_ROWS  # late-bound so tests can shrink it
-    datas, valids, idx = _host_columns(page)
+    datas, valids, datas2, idx = _host_columns(page)
     n = len(idx)
     nchunks = max(1, -(-n // chunk_rows))
     out = []
     for c in range(nchunks):
         sl = slice(c * chunk_rows, min((c + 1) * chunk_rows, n))
         cols: dict[str, np.ndarray] = {}
-        for i, (d, v) in enumerate(zip(datas, valids)):
+        for i, (d, v, d2) in enumerate(zip(datas, valids, datas2)):
             cols[f"c{i:04d}"] = d[sl]
             if v is not None:
                 cols[f"v{i:04d}"] = v[sl]
+            if d2 is not None:
+                cols[f"d{i:04d}"] = d2[sl]
         out.append(page_serde().serialize_columns(cols))
     return out
 
@@ -174,6 +182,20 @@ def wire_to_page(
             for j, s in enumerate(data):
                 decoded[j] = tuple(_json.loads(s)) if isinstance(s, str) and s else ()
             data = decoded
+        has_limbs = any(f"d{i:04d}" in p for p in parts)
+        hi = None
+        if has_limbs:
+            # decimal128 high limb: producers that stayed single-lane send
+            # no "d" key — their high limb is the sign extension of the lane
+            hparts = []
+            for p in parts:
+                if f"d{i:04d}" in p:
+                    hparts.append(np.asarray(p[f"d{i:04d}"], np.int64))
+                elif f"c{i:04d}" in p:
+                    hparts.append(
+                        np.asarray(p[f"c{i:04d}"], np.int64) >> 63
+                    )
+            hi = np.concatenate(hparts) if hparts else np.empty((0,), np.int64)
         if cap > n:
             fill = np.zeros((cap - n,), dtype=object if wire_obj else t.np_dtype)
             if t.is_string:
@@ -184,7 +206,22 @@ def wire_to_page(
             data = np.concatenate([data, fill])
             if valid is not None:
                 valid = np.concatenate([valid, np.zeros(cap - n, np.bool_)])
-        columns.append(Column.from_numpy(t, data, valid))
+            if hi is not None:
+                hi = np.concatenate([hi, np.zeros(cap - n, np.int64)])
+        if hi is not None:
+            import jax.numpy as _jnp
+
+            columns.append(
+                Column(
+                    t,
+                    _jnp.asarray(np.asarray(data, np.int64)),
+                    None if valid is None else _jnp.asarray(valid),
+                    None,
+                    _jnp.asarray(hi),
+                )
+            )
+        else:
+            columns.append(Column.from_numpy(t, data, valid))
     live = None
     if cap > total:
         import jax.numpy as _jnp
@@ -264,6 +301,17 @@ def partition_page(
                 bits = data.astype(np.float64).view(np.uint64)
             else:
                 bits = data.astype(np.int64).view(np.uint64)
+            if kv.data2 is not None:
+                # mirror ops/relops.py _combined_hash: mix hi only when it
+                # adds information beyond sign extension of the low lane
+                lo = data.astype(np.int64)
+                hi = np.asarray(kv.data2).astype(np.int64)
+                extra = np.where(
+                    hi == (lo >> 63),
+                    np.uint64(0),
+                    _mix64_np(hi.view(np.uint64)),
+                )
+                bits = bits ^ extra
         h = _mix64_np(h ^ _mix64_np(bits))
     part = (h % np.uint64(max(nparts, 1))).astype(np.int64)
     # NULL-key rows route to partition 0 (matching the device exchange,
@@ -272,16 +320,18 @@ def partition_page(
     # whatever garbage the dead lanes carry.
     part = np.where(keys_ok, part, 0)
 
-    datas, valids, _ = _host_columns(page)
+    datas, valids, datas2, _ = _host_columns(page)
     part_live = part[idx]
     out = []
     for p in range(nparts):
         keep = part_live == p
         cols_p: dict[str, np.ndarray] = {}
-        for i, (d, v) in enumerate(zip(datas, valids)):
+        for i, (d, v, d2) in enumerate(zip(datas, valids, datas2)):
             cols_p[f"c{i:04d}"] = d[keep]
             if v is not None:
                 cols_p[f"v{i:04d}"] = v[keep]
+            if d2 is not None:
+                cols_p[f"d{i:04d}"] = d2[keep]
         out.append(_chunk_blob_columns(cols_p, int(keep.sum()), chunk_rows))
     return out
 

@@ -20,8 +20,10 @@ Reference wiring this replaces (SURVEY §2.8, §3.2-3.3):
                               (HttpPageBufferClient.java:406-424)
   DELETE /v1/task/{id}        abort + free buffers
   GET  /v1/info               heartbeat (failuredetector/HeartbeatFailureDetector)
-  POST /v1/inject_failure     test-only fault injection
-                              (execution/FailureInjector.java:33)
+  POST /v1/inject_failure     test-only fault matrix (ERROR | TIMEOUT |
+                              SLOW | EXCHANGE_DROP, counted/probabilistic;
+                              execution/FailureInjector.java:33 — see
+                              runtime/failure.py FaultInjector)
 
 A task executes its fragment with the jitted LocalExecutor over its split
 range, partitions output rows per the fragment's output kind into
@@ -45,6 +47,7 @@ from ..connectors.spi import CatalogManager
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
+from .failure import Backoff, FaultInjector
 from .spool import SPOOL_URL, SpooledExchange
 from .wire import page_to_wire_chunks, partition_page, wire_to_page
 
@@ -100,7 +103,7 @@ class Worker:
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.tasks: dict[str, _Task] = {}
-        self.injected_failures: set[str] = set()
+        self.fault_injector = FaultInjector()
         # output-buffer memory bound (reference: OutputBufferMemoryManager):
         # finished chunks past this byte budget spill to a local directory
         # and are served back by file read.  The dir is created eagerly (a
@@ -202,13 +205,9 @@ class Worker:
 
     def _run_task(self, task: _Task, req: dict) -> None:
         try:
-            with self._lock:  # one-shot injection (FailureInjector.java:33)
-                if task.task_id in self.injected_failures:
-                    self.injected_failures.discard(task.task_id)
-                    raise RuntimeError(f"injected failure for task {task.task_id}")
-                if "*" in self.injected_failures:
-                    self.injected_failures.discard("*")
-                    raise RuntimeError(f"injected failure for task {task.task_id}")
+            # fault matrix (FailureInjector.java:33): ERROR/TIMEOUT raise
+            # here, SLOW delays and falls through to normal execution
+            self.fault_injector.task_fault(task.task_id)
             fragment = plan_from_json(req["fragment"])
             executor = LocalExecutor(self.catalogs, self.default_catalog)
             executor.split = (req["part"], req["num_parts"])
@@ -371,15 +370,26 @@ class Worker:
 
 
 def _stream_fetch(
-    worker_url: str, task_id: str, buffer_id: int, ack: bool = True
+    worker_url: str,
+    task_id: str,
+    buffer_id: int,
+    ack: bool = True,
+    backoff: Optional[Backoff] = None,
 ) -> list[bytes]:
     """Token-sequenced consumption of one producer buffer with acknowledge —
     the reference's HttpPageBufferClient loop (sendGetResults:355, token+ack
     :406-424).  Retries make delivery at-least-once; exact token addressing
-    makes assembly exactly-once."""
+    makes assembly exactly-once.
+
+    Transient errors (connection failures, HTTP 502/503/504 — including
+    injected EXCHANGE_DROP faults) retry through a jittered exponential
+    Backoff and RESUME from the current token: already-fetched chunks are
+    never re-appended, already-sent acks never un-free.  Only the backoff
+    deadline escalates to a task-level failure.  Permanent errors (500 ==
+    producer task failed, 404/410 == buffer gone) raise immediately."""
     blobs: list[bytes] = []
     token = 0
-    attempts = 0
+    backoff = backoff or Backoff()
     while True:
         url = f"{worker_url}/v1/task/{task_id}/results/{buffer_id}/{token}?wait=30"
         try:
@@ -388,18 +398,28 @@ def _stream_fetch(
                 complete = r.headers.get("X-Complete") == "1"
                 no_data = r.headers.get("X-No-Data") == "1"
         except urllib.error.HTTPError as e:
-            # 500 = producer task failed, 404/410 = buffer gone: permanent
             detail = e.read().decode(errors="replace")
+            if e.code in (502, 503, 504):  # transient: retry same token
+                if backoff.failure():
+                    raise RuntimeError(
+                        f"fetch {task_id}/{buffer_id}/{token} from "
+                        f"{worker_url}: gave up after "
+                        f"{backoff.failure_count} attempts: "
+                        f"HTTP {e.code}: {detail}"
+                    )
+                backoff.sleep()
+                continue
+            # 500 = producer task failed, 404/410 = buffer gone: permanent
             raise RuntimeError(
                 f"fetch {task_id}/{buffer_id}/{token} from {worker_url}: "
                 f"HTTP {e.code}: {detail}"
             )
         except Exception:
-            attempts += 1
-            if attempts > 5:
+            if backoff.failure():
                 raise
+            backoff.sleep()
             continue
-        attempts = 0
+        backoff.success()
         if body and not no_data:
             blobs.append(body)
             token += 1
@@ -472,6 +492,10 @@ def _make_handler(worker: Worker):
                 if len(parts) >= 7 and parts[6] == "acknowledge":
                     worker.acknowledge(task_id, buffer_id, int(parts[5]))
                     return self._send(200, b"{}", "application/json")
+                if worker.fault_injector.drop_fetch(task_id):
+                    # EXCHANGE_DROP: transient 503 — consumers must retry
+                    # through Backoff and resume from their token
+                    return self._send(503, b"injected exchange drop")
                 token = int(parts[5]) if len(parts) >= 6 else 0
                 wait = float(params.get("wait", "0"))
                 code, body, headers = worker.get_chunk(task_id, buffer_id, token, wait)
@@ -488,7 +512,17 @@ def _make_handler(worker: Worker):
                 return self._send(200, b'{"state": "RUNNING"}', "application/json")
             if parts[:2] == ["v1", "inject_failure"]:
                 req = json.loads(body)
-                worker.injected_failures.add(req.get("task_id", "*"))
+                try:
+                    worker.fault_injector.arm(
+                        task_id=req.get("task_id", "*"),
+                        mode=req.get("mode", "ERROR"),
+                        delay_ms=req.get("delay_ms", 0),
+                        count=req.get("count", 1),
+                        probability=req.get("probability", 1.0),
+                        seed=req.get("seed"),
+                    )
+                except ValueError as e:
+                    return self._send(400, str(e).encode())
                 return self._send(200, b"{}", "application/json")
             return self._send(404, b"not found")
 

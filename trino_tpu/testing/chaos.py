@@ -1,0 +1,133 @@
+"""Seeded chaos harness for the multi-host runtime.
+
+Reference: the reference engine proves fault tolerance by running its
+product-test query suites under injected faults (FailureInjector wired
+through TestingTrinoServer.injectTaskFailure) and asserting results still
+match the H2 oracle.  Same structure here: a ChaosRunner wraps the
+in-process DistributedQueryRunner, arms a RANDOM-BUT-SEEDED schedule of
+faults from the worker fault matrix before every query, runs the query
+under retry_policy=TASK, and hands the caller the rows to diff against the
+sqlite oracle.  Determinism: one `random.Random(seed)` drives every choice
+(mode, target worker, delay, count), so a failing schedule replays exactly
+from its seed.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional, Sequence
+
+from .runner import DistributedQueryRunner
+
+__all__ = ["ChaosRunner"]
+
+# modes that a retry_policy=TASK cluster must absorb without losing the
+# query: ERROR/TIMEOUT fail the task (re-scheduled on another worker),
+# SLOW delays it (no failure at all), EXCHANGE_DROP 503s page fetches
+# (consumer Backoff resumes from its ack token)
+RECOVERABLE_MODES = ("ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP")
+
+
+class ChaosRunner:
+    """Arm seeded random fault schedules around queries on a live cluster.
+
+    Usage:
+        chaos = ChaosRunner(runner, seed=7)
+        for name, sql in queries:
+            got = chaos.run_query(sql)        # faults armed, query survives
+            assert_rows_equal(got, oracle.query(sql))
+        assert len(chaos.fired_modes()) >= 3  # the schedule actually bit
+    """
+
+    def __init__(
+        self,
+        runner: DistributedQueryRunner,
+        seed: int = 0,
+        modes: Sequence[str] = RECOVERABLE_MODES,
+        max_faults_per_query: int = 2,
+    ):
+        self.runner = runner
+        self.rng = random.Random(seed)
+        self.modes = tuple(modes)
+        self.max_faults_per_query = max_faults_per_query
+        self.schedule: list[list[dict]] = []  # one entry per run_query
+
+    # ------------------------------------------------------------ schedule
+
+    def arm_random_faults(self) -> list[dict]:
+        """Arm 1..max_faults rules drawn from the seeded rng and return the
+        armed schedule (also appended to self.schedule for replay logs)."""
+        events = []
+        for _ in range(self.rng.randint(1, self.max_faults_per_query)):
+            mode = self.rng.choice(self.modes)
+            ev = {
+                "mode": mode,
+                "worker_index": self.rng.randrange(len(self.runner.workers)),
+                "task_id": "*",
+                "delay_ms": (
+                    self.rng.choice((50, 150, 300))
+                    if mode in ("TIMEOUT", "SLOW")
+                    else 0
+                ),
+                "count": self.rng.randint(1, 3) if mode == "EXCHANGE_DROP" else 1,
+            }
+            self.runner.inject_task_failure(**ev)
+            events.append(ev)
+        self.schedule.append(events)
+        return events
+
+    def clear_faults(self) -> None:
+        """Disarm leftover rules on every worker (a rule armed for a stage
+        that never ran on its worker would otherwise leak into the next
+        query)."""
+        for w in self.runner.workers:
+            w.fault_injector.clear()
+
+    # ------------------------------------------------------------ running
+
+    def run_query(self, sql: str, arm: bool = True) -> list[tuple]:
+        """Arm a random schedule, run `sql`, disarm leftovers, return rows.
+        The query is expected to SURVIVE — any RuntimeError propagates to
+        the caller (a real resilience failure, replayable from the seed)."""
+        if arm:
+            self.arm_random_faults()
+        try:
+            return self.runner.query(sql)
+        finally:
+            self.clear_faults()
+
+    # ------------------------------------------------------------ observability
+
+    def fired(self) -> list[tuple[str, str]]:
+        """(mode, task_id) pairs that actually fired, across all workers."""
+        out: list[tuple[str, str]] = []
+        for w in self.runner.workers:
+            out.extend(w.fault_injector.fired)
+        return out
+
+    def fired_modes(self) -> set[str]:
+        return {mode for mode, _ in self.fired()}
+
+    def armed_modes(self) -> set[str]:
+        return {ev["mode"] for events in self.schedule for ev in events}
+
+
+def make_chaos_cluster(
+    catalog_factory,
+    num_workers: int = 3,
+    default_catalog: str = "tpch",
+    heartbeat_interval: float = 1.0,
+    seed: int = 0,
+    modes: Sequence[str] = RECOVERABLE_MODES,
+) -> tuple[DistributedQueryRunner, ChaosRunner]:
+    """Start a retry_policy=TASK cluster plus its ChaosRunner.  The caller
+    owns shutdown (runner.stop())."""
+    runner = DistributedQueryRunner(
+        num_workers=num_workers,
+        default_catalog=default_catalog,
+        heartbeat_interval=heartbeat_interval,
+    )
+    runner.register_catalog(default_catalog, catalog_factory())
+    runner.start()
+    runner.coordinator.session.set("retry_policy", "TASK")
+    return runner, ChaosRunner(runner, seed=seed, modes=modes)

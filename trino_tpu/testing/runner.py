@@ -81,13 +81,34 @@ class DistributedQueryRunner:
         _, rows = StatementClient(self.coordinator.url).execute(sql)
         return [tuple(r) for r in rows]
 
-    def inject_task_failure(self, worker_index: int = 0, task_id: str = "*") -> None:
-        """Fault injection (reference: TestingTrinoServer.injectTaskFailure,
-        server/testing/TestingTrinoServer.java:709)."""
+    def inject_task_failure(
+        self,
+        worker_index: int = 0,
+        task_id: str = "*",
+        mode: str = "ERROR",
+        delay_ms: int = 0,
+        count: int = 1,
+        probability: float = 1.0,
+        seed: int | None = None,
+    ) -> None:
+        """Arm one rule of the worker's fault matrix (reference:
+        TestingTrinoServer.injectTaskFailure, FailureInjector.java).  Modes:
+        ERROR (raise), TIMEOUT (sleep delay_ms then raise), SLOW (sleep
+        delay_ms then run), EXCHANGE_DROP (503 the next `count` page
+        fetches).  probability<1 arms a seeded probabilistic variant."""
         w = self.workers[worker_index]
+        body = {
+            "task_id": task_id,
+            "mode": mode,
+            "delay_ms": delay_ms,
+            "count": count,
+            "probability": probability,
+        }
+        if seed is not None:
+            body["seed"] = seed
         req = urllib.request.Request(
             f"{w.url}/v1/inject_failure",
-            data=json.dumps({"task_id": task_id}).encode(),
+            data=json.dumps(body).encode(),
         )
         urllib.request.urlopen(req, timeout=10).read()
 
