@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Observability smoke: spins a 2-worker cluster and asserts the whole
+# observability plane end to end — distributed EXPLAIN ANALYZE with
+# per-operator [rows, ms] annotations on every stage, Prometheus /metrics
+# on coordinator AND workers, the /v1/query/{id} QueryInfo endpoint, and
+# traceparent propagation into worker task spans.
+#
+# Fast enough to run on every runtime/ or exec/ change; the same checks
+# run under the tier-1 gate via tests/test_obs_plane.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import urllib.request
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.testing.runner import DistributedQueryRunner
+
+SQL = ("select l_returnflag, count(*) c from lineitem "
+       "where l_quantity < 30 group by l_returnflag order by c desc")
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+runner = DistributedQueryRunner(num_workers=2)
+runner.register_catalog("tpch", TpchConnector(0.01))
+runner.start()
+try:
+    rows = runner.query("explain analyze " + SQL)
+    text = "\n".join(r[0] for r in rows)
+    print(text)
+    print()
+
+    assert text.count("Fragment") >= 2, "expected a multi-stage plan"
+    bare = [
+        ln for ln in text.splitlines()
+        if ln.strip() and not ln.lstrip().startswith(("Fragment", "--", "wall:", "tasks:"))
+        and "[rows:" not in ln
+    ]
+    assert not bare, f"operator lines missing stats: {bare}"
+    assert "slowest operator:" in text and "cluster cpu:" in text
+
+    coord = runner.coordinator
+    base = coord.url
+    mtext = get(base + "/metrics")
+    assert "trino_tpu_queries_total" in mtext
+    assert "trino_tpu_tasks_dispatched_total" in mtext
+    print(f"coordinator /metrics: {len(mtext.splitlines())} lines ok")
+
+    for w in runner.workers:
+        wtext = get(f"{w.url}/metrics")
+        assert "trino_tpu_worker_tasks_total" in wtext
+        print(f"worker {w.url} /metrics: {len(wtext.splitlines())} lines ok")
+
+    with coord._lock:
+        qid = sorted(coord.queries)[-1]
+    info = json.loads(get(f"{base}/v1/query/{qid}"))
+    assert info["stage_count"] >= 2 and info["cpu_ms"] > 0
+    print(f"/v1/query/{qid}: {info['stage_count']} stages, "
+          f"cpu {info['cpu_ms']:.0f} ms ok")
+    print("OBS_SMOKE_OK")
+finally:
+    runner.stop()
+EOF

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Flame summary of a JSONL trace export.
+
+Every component (Engine, Coordinator, Worker) appends one JSON line per
+finished ROOT span to the file named by TRINO_TPU_TRACE_FILE
+(utils/tracing.py JsonlSpanExporter).  A distributed query therefore lands
+as several lines sharing one trace_id: the coordinator's `query` span plus
+each worker's `task` spans, stitched back together here via parent_id —
+the zero-dependency analogue of viewing the reference's OpenTelemetry
+export in Jaeger.
+
+Usage:
+    TRINO_TPU_TRACE_FILE=/tmp/trace.jsonl python ... (run queries) ...
+    python scripts/trace_dump.py /tmp/trace.jsonl [--trace TRACE_ID]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_roots(path: str) -> list[dict]:
+    roots = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                roots.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn concurrent write: skip, don't die
+    return roots
+
+
+def stitch(roots: list[dict]) -> dict[str, dict]:
+    """trace_id -> synthetic root whose children are the exported root
+    spans, remote children nested under their parent_id when it's known."""
+    by_trace: dict[str, list[dict]] = {}
+    for r in roots:
+        by_trace.setdefault(r.get("trace_id", "?"), []).append(r)
+    out = {}
+    for trace_id, spans in by_trace.items():
+        # span_id -> exported span dict, covering nested children too, so a
+        # worker task span whose parent_id is the coordinator's query span
+        # (propagated via traceparent) nests under it
+        known: dict[str, dict] = {}
+        for s in spans:
+            for sid, holder in _index(s):
+                known[sid] = holder
+        top = []
+        for s in spans:
+            parent = known.get(s.get("parent_id") or "")
+            if parent is not None and parent is not s:
+                parent.setdefault("children", []).append(s)
+            else:
+                top.append(s)
+        out[trace_id] = {
+            "trace_id": trace_id,
+            "spans": top,
+            "total_ms": sum(s.get("duration_ms", 0.0) for s in top),
+        }
+    return out
+
+
+def _index(span):
+    """Yield (span_id, owning span dict) for the span and all descendants."""
+    sid = span.get("span_id")
+    if sid:
+        yield sid, span
+    for c in span.get("children", []):
+        yield from _index(c)
+
+
+def print_flame(span: dict, total_ms: float, indent: int = 0) -> None:
+    ms = span.get("duration_ms", 0.0)
+    pct = 100.0 * ms / total_ms if total_ms else 0.0
+    bar = "#" * max(1, int(pct / 5))
+    attrs = span.get("attributes") or {}
+    label = span.get("name", "?")
+    for key in ("query_id", "task_id", "worker"):
+        if key in attrs:
+            label += f" {attrs[key]}"
+    print(f"{'  ' * indent}{ms:10.1f} ms {pct:5.1f}% {bar:<20} {label}")
+    for c in sorted(
+        span.get("children", []), key=lambda c: -c.get("duration_ms", 0.0)
+    ):
+        print_flame(c, total_ms, indent + 1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="JSONL trace file (TRINO_TPU_TRACE_FILE)")
+    ap.add_argument("--trace", help="only this trace_id")
+    args = ap.parse_args()
+
+    traces = stitch(load_roots(args.path))
+    if args.trace:
+        traces = {k: v for k, v in traces.items() if k == args.trace}
+    if not traces:
+        print("no traces found", file=sys.stderr)
+        return 1
+    for trace_id, t in traces.items():
+        roots = t["spans"]
+        wall = max(
+            (s.get("duration_ms", 0.0) for s in roots), default=0.0
+        )
+        print(f"=== trace {trace_id}  ({len(roots)} root span(s), "
+              f"{wall:.1f} ms wall)")
+        for s in sorted(roots, key=lambda s: -s.get("duration_ms", 0.0)):
+            print_flame(s, wall or 1.0)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

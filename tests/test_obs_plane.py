@@ -1,0 +1,354 @@
+"""Query observability plane: distributed EXPLAIN ANALYZE, the operator
+stats pipeline, Prometheus /metrics exposition, and W3C trace propagation
+(reference: QueryInfo/StageStats/OperatorStats, the JMX metrics surface,
+and the OpenTelemetry propagator on task HTTP calls)."""
+
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.testing import DistributedQueryRunner
+from trino_tpu.utils import metrics as M
+from trino_tpu.utils.tracing import (
+    InMemorySpanExporter,
+    Tracer,
+    parse_traceparent,
+    traceparent,
+)
+
+# ------------------------------------------------------------- metrics unit
+
+# Prometheus text exposition 0.0.4: every sample line is
+# `name{label="v",...} value` with a float-parseable value
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [^ ]+$'
+)
+
+
+def _assert_prometheus_parses(text: str) -> dict:
+    """Validate the exposition format; return {sample_line_name: value}."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+        name_part, value = line.rsplit(" ", 1)
+        float(value)  # must parse
+        samples[name_part] = float(value)
+    return samples
+
+
+def test_counter_gauge_histogram_render():
+    reg = M.MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", ("code",))
+    c.labels("200").inc()
+    c.labels("200").inc(2)
+    c.labels("500").inc()
+    g = reg.gauge("t_inflight", "in flight")
+    g.set(7)
+    h = reg.histogram("t_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    samples = _assert_prometheus_parses(text)
+    assert samples['t_requests_total{code="200"}'] == 3
+    assert samples['t_requests_total{code="500"}'] == 1
+    assert samples["t_inflight"] == 7
+    assert samples['t_seconds_bucket{le="0.1"}'] == 1
+    assert samples['t_seconds_bucket{le="1"}'] == 2
+    assert samples['t_seconds_bucket{le="+Inf"}'] == 3
+    assert samples["t_seconds_count"] == 3
+    assert "# HELP t_requests_total requests" in text
+    assert "# TYPE t_seconds histogram" in text
+
+
+def test_registry_get_or_create_and_mismatch():
+    reg = M.MetricsRegistry()
+    a = reg.counter("t_x_total", "x")
+    assert reg.counter("t_x_total", "x") is a
+    with pytest.raises(ValueError):
+        reg.counter("t_x_total", "x", ("label",))  # same name, new shape
+    with pytest.raises(ValueError):
+        reg.gauge("t_x_total", "x")  # same name, different kind
+
+
+def test_counter_thread_safety():
+    reg = M.MetricsRegistry()
+    c = reg.counter("t_threads_total", "t")
+
+    def bump():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+
+
+# ------------------------------------------------------------ tracing unit
+
+
+def test_traceparent_round_trip():
+    tracer = Tracer()
+    with tracer.span("query") as span:
+        header = traceparent(span)
+    assert re.match(r"^00-[0-9a-f]{32}-[0-9a-f]{16}-01$", header)
+    trace_id, span_id = parse_traceparent(header)
+    assert trace_id == span.trace_id and span_id == span.span_id
+    assert parse_traceparent("junk") is None
+    assert parse_traceparent("00-zz-yy-01") is None
+    assert parse_traceparent(None or "") is None
+
+
+def test_tracer_join_adopts_remote_trace():
+    coord, worker = Tracer(), Tracer()
+    with coord.span("query") as qspan:
+        header = traceparent(qspan)
+    exp = InMemorySpanExporter()
+    worker.add_exporter(exp)
+    assert worker.join(header)
+    with worker.span("task"):
+        pass
+    (task_span,) = exp.snapshot()
+    assert task_span.trace_id == qspan.trace_id
+    assert task_span.parent_id == qspan.span_id
+    # the joined context is one-shot: the next root is a fresh trace
+    with worker.span("task2"):
+        pass
+    assert exp.snapshot()[-1].trace_id != qspan.trace_id
+
+
+def test_tracer_concurrent_roots_thread_safe():
+    tracer = Tracer()
+    exp = InMemorySpanExporter()
+    tracer.add_exporter(exp)
+
+    def run(i):
+        with tracer.span("query", i=i):
+            with tracer.span("child"):
+                pass
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = exp.snapshot()
+    assert len(spans) == 16
+    assert len({s.trace_id for s in spans}) == 16  # no cross-thread bleed
+    assert all(len(s.children) == 1 for s in spans)
+
+
+# ----------------------------------------------------- distributed pipeline
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runner = DistributedQueryRunner(num_workers=2)
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    yield runner
+    runner.stop()
+
+
+ANALYZE_SQL = (
+    "explain analyze select l_returnflag, count(*) c from lineitem "
+    "where l_quantity < 30 group by l_returnflag order by c desc"
+)
+
+
+def test_distributed_explain_analyze_all_stages_annotated(cluster):
+    rows = cluster.query(ANALYZE_SQL)
+    text = "\n".join(r[0] for r in rows)
+    frags = [ln for ln in text.splitlines() if ln.startswith("Fragment")]
+    assert len(frags) >= 2, text  # multi-stage plan: root + worker stages
+    # EVERY operator line in EVERY stage carries rows AND eager ms —
+    # no silent stats-less fallback
+    for ln in text.splitlines():
+        if ln.startswith(("Fragment", "--")) or not ln.strip():
+            continue
+        assert "[rows: " in ln, f"stats-less operator line: {ln!r}"
+        assert " ms]" in ln, f"un-timed operator line: {ln!r}"
+    assert "slowest operator:" in text
+    assert "cluster cpu:" in text
+    # worker stages report their wall interval relative to query start
+    assert any("wall:" in f for f in frags[1:])
+
+
+def test_query_info_endpoint(cluster):
+    cluster.query("select count(*) from orders")
+    qid = list(cluster.coordinator.queries)[-1]
+    with urllib.request.urlopen(
+        f"{cluster.coordinator.url}/v1/query/{qid}"
+    ) as r:
+        info = json.loads(r.read())
+    assert info["state"] == "FINISHED"
+    assert info["stage_count"] >= 2
+    assert info["cpu_ms"] > 0
+    for stage in info["stages"]:
+        assert stage["operators"], f"stage {stage['stage_id']} has no stats"
+        for s in stage["operators"].values():
+            assert s["rows"] >= 0 and s["invocations"] >= 1
+    # every non-root stage ran real tasks with exchange accounting
+    worker_tasks = [
+        t for st in info["stages"] for t in st["tasks"]
+        if t["worker"] != "coordinator"
+    ]
+    assert worker_tasks and all(t["wall_ms"] is not None for t in worker_tasks)
+
+
+def test_metrics_endpoints_parse_and_counters_move(cluster):
+    def scrape(url):
+        with urllib.request.urlopen(f"{url}/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            return _assert_prometheus_parses(r.read().decode())
+
+    before = scrape(cluster.coordinator.url)
+    cluster.query("select count(*) from region")
+    after = scrape(cluster.coordinator.url)
+    fin = 'trino_tpu_queries_total{state="FINISHED"}'
+    assert after.get(fin, 0) == before.get(fin, 0) + 1
+    assert after["trino_tpu_tasks_dispatched_total"] > before.get(
+        "trino_tpu_tasks_dispatched_total", 0
+    )
+    assert after["trino_tpu_query_seconds_count"] >= 1
+    wsamples = scrape(cluster.workers[0].url)
+    assert wsamples['trino_tpu_worker_tasks_total{event="finished"}'] >= 1
+    assert "trino_tpu_exchange_served_bytes_total" in wsamples
+    # process-global data-plane counters ride along on every scrape
+    assert any(k.startswith("trino_tpu_jit_cache_lookups_total") for k in wsamples)
+
+
+def test_trace_propagates_coordinator_to_workers(cluster):
+    wexps = []
+    for w in cluster.workers:
+        exp = InMemorySpanExporter()
+        w.tracer.add_exporter(exp)
+        wexps.append(exp)
+    cexp = InMemorySpanExporter()
+    cluster.coordinator.tracer.add_exporter(cexp)
+    try:
+        cluster.query("select count(*) from nation")
+    finally:
+        cluster.coordinator.tracer._exporters.clear()
+        for w in cluster.workers:
+            w.tracer._exporters.clear()
+    (qspan,) = [s for s in cexp.snapshot() if s.name == "query"]
+    task_spans = [s for exp in wexps for s in exp.snapshot() if s.name == "task"]
+    assert task_spans, "no worker task spans exported"
+    assert all(s.trace_id == qspan.trace_id for s in task_spans)
+    assert all(s.parent_id == qspan.span_id for s in task_spans)
+
+
+def test_coordinator_events_enriched(cluster):
+    events = []
+    cluster.coordinator.add_event_listener(events.append)
+    try:
+        cluster.query("select count(*) from region")
+    finally:
+        cluster.coordinator.events._listeners.clear()
+    kinds = [e.kind for e in events]
+    assert kinds == ["created", "completed"]
+    done = events[-1]
+    assert done.rows == 1 and done.wall_s > 0
+    assert done.stage_count >= 2
+    assert done.cpu_ms > 0
+
+
+def test_explain_format_json_session_property(cluster):
+    coord = cluster.coordinator
+    coord.session.set("explain_format", "json")
+    try:
+        rows = cluster.query("explain select count(*) from region")
+        obj = json.loads(rows[0][0])
+        assert obj["operator"] and isinstance(obj["children"], list)
+        rows = cluster.query("explain analyze select count(*) from region")
+        info = json.loads(rows[0][0])
+        assert info["stage_count"] >= 2
+        assert all(st["operators"] for st in info["stages"])
+    finally:
+        coord.session.set("explain_format", "text")
+
+
+def test_explain_format_json_local_engine():
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine()
+    eng.register_catalog("tpch", TpchConnector(0.01))
+    eng.execute("set session explain_format = 'json'")
+    obj = json.loads(eng.execute("explain select count(*) from region")[0][0])
+    assert obj["operator"] == "Aggregate" or obj["children"]
+    out = json.loads(
+        eng.execute("explain analyze select count(*) from region")[0][0]
+    )
+    assert out["output_rows"] == 1
+    stats = [n.get("stats") for n in _walk_obj(out["plan"])]
+    assert any(s and "rows" in s for s in stats)
+
+
+def _walk_obj(obj):
+    yield obj
+    for c in obj.get("children", []):
+        yield from _walk_obj(c)
+
+
+def test_ui_has_wall_and_state_age_columns(cluster):
+    with urllib.request.urlopen(f"{cluster.coordinator.url}/ui") as r:
+        page = r.read().decode()
+    assert "wall (s)" in page and "in state (s)" in page
+    assert "seen (s)" in page
+
+
+# ------------------------------------------------------- chaos + counters
+
+
+def test_retry_counters_under_injected_faults():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+
+    conn = MemoryConnector()
+    conn.create_table("t", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)])
+    rng = np.random.default_rng(7)
+    conn.insert("t", {
+        "k": rng.integers(0, 50, 20_000).astype(np.int64),
+        "v": rng.integers(0, 1000, 20_000).astype(np.int64),
+    })
+    runner = DistributedQueryRunner(
+        num_workers=2, default_catalog="mem", heartbeat_interval=0.3
+    )
+    runner.register_catalog("mem", conn)
+    runner.start()
+    try:
+        sql = "select k, sum(v) from t group by k order by k"
+        clean = runner.query(sql)
+        runner.coordinator.session.set("retry_policy", "TASK")
+        runner.inject_task_failure(worker_index=0, mode="ERROR")
+        assert runner.query(sql) == clean
+        qid = list(runner.coordinator.queries)[-1]
+        with urllib.request.urlopen(
+            f"{runner.coordinator.url}/v1/query/{qid}"
+        ) as r:
+            info = json.loads(r.read())
+        assert info["task_retries"] >= 1
+        with urllib.request.urlopen(f"{runner.coordinator.url}/metrics") as r:
+            samples = _assert_prometheus_parses(r.read().decode())
+        assert samples["trino_tpu_task_retries_total"] >= 1
+        wsamples = _assert_prometheus_parses(
+            urllib.request.urlopen(
+                f"{runner.workers[0].url}/metrics"
+            ).read().decode()
+        )
+        assert wsamples['trino_tpu_worker_tasks_total{event="failed"}'] >= 1
+    finally:
+        runner.stop()

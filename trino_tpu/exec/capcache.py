@@ -27,6 +27,14 @@ from typing import Optional
 
 __all__ = ["load_caps", "store_caps"]
 
+from ..utils.metrics import GLOBAL as _METRICS
+
+_CAPS_LOOKUPS = _METRICS.counter(
+    "trino_tpu_caps_cache_lookups_total",
+    "Persistent learned-capacity cache lookups",
+    ("result",),
+)
+
 _LOCK = threading.Lock()
 _MAX_ENTRIES = 1024
 _mem: Optional[dict] = None  # file contents, loaded once per process
@@ -67,6 +75,7 @@ def load_caps(plan, inputs: dict) -> Optional[dict[int, int]]:
         return None
     with _LOCK:
         entry = _load_file().get(key)
+    _CAPS_LOOKUPS.labels("miss" if entry is None else "hit").inc()
     if entry is None:
         return None
     return {int(k): int(v) for k, v in entry.items()}

@@ -41,6 +41,13 @@ from ..plan.nodes import (
 
 __all__ = ["LocalExecutor", "MemoryBudgetExceeded"]
 
+# collect_stats row counters ride the same `required` pytree as capacity
+# overflow counters; the dict must stay int-keyed (shard_map sorts pytree
+# dict keys, and mixed int/tuple keys don't sort together).  Capacity keys
+# are small preorder ids, EnforceSingleRow uses -(nid+1), so a large base
+# offset keeps the three ranges disjoint.
+_STATS_ROWS_BASE = 1_000_000
+
 
 class MemoryBudgetExceeded(RuntimeError):
     """Planned capacities exceed the task's device-memory budget; the FTE
@@ -124,6 +131,14 @@ class LocalExecutor:
         # executions skip the growth retries (the reference's runtime-adaptive
         # statistics feedback, AdaptivePlanner, in miniature)
         self._learned_caps: dict[PlanNode, dict[int, int]] = {}
+        # operator-stats collection (reference: OperatorStats via
+        # OperatorContext): when set, execute() reports every node's live
+        # output-row count from inside the compiled program and leaves the
+        # per-operator summary in last_operator_stats — works for the jitted,
+        # eager and SPMD paths alike, so distributed tasks carry stats too
+        self.collect_operator_stats = False
+        self.last_operator_stats: dict[int, dict] = {}
+        self.last_execute_wall_ms: Optional[float] = None
 
     # ------------------------------------------------------------- table IO
     def table_page(
@@ -251,6 +266,9 @@ class LocalExecutor:
     ) -> Page:
         """remote_pages: fragment_id -> input Page for RemoteSource leaves
         (multi-host task execution, runtime/worker.py)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         nodes = _node_ids(plan)
         inputs = {}
         for i, n in nodes.items():
@@ -306,7 +324,9 @@ class LocalExecutor:
         eager_only = _has_host_aggs(plan)
         for _ in range(12):  # capacity-retry loop (jitted path)
             if eager_only:
-                out_page, required = _trace_plan(plan, inputs, caps)
+                out_page, required = _trace_plan(
+                    plan, inputs, caps, collect_stats=self.collect_operator_stats
+                )
                 required = {k: int(v) for k, v in required.items()}
             else:
                 out_page, required = self._run(plan, inputs, caps)
@@ -338,6 +358,11 @@ class LocalExecutor:
                 from .capcache import store_caps
 
                 store_caps(plan, inputs, caps)
+                if self.collect_operator_stats:
+                    jax.block_until_ready([c.data for c in out_page.columns])
+                    self._record_operator_stats(
+                        nodes, required, (_time.perf_counter() - t0) * 1e3
+                    )
                 return out_page
             for nid, req in overflow.items():
                 caps[nid] = _pow2(max(req, caps[nid] * 2))
@@ -364,7 +389,8 @@ class LocalExecutor:
                     n.catalog, n.table, n.column_names, n.output_types, scan_id=i
                 )
         caps = self._learned_caps[plan]
-        cache_key = (plan, tuple(sorted(caps.items())),
+        cache_key = (plan, self.collect_operator_stats,
+                     tuple(sorted(caps.items())),
                      tuple(sorted((k, p.capacity) for k, p in inputs.items())))
         fn, _holder = self._jit_cache[cache_key]
         out, packed = fn(inputs)
@@ -475,7 +501,33 @@ class LocalExecutor:
         size_of(0, nodes[0])
         return caps
 
-    def explain_analyze(self, plan: PlanNode) -> tuple[Page, dict]:
+    def _record_operator_stats(self, nodes, required, wall_ms=None) -> None:
+        """Distill a run's `required` row counters into the per-operator
+        summary the stats pipeline ships worker -> coordinator:
+        {nid: {operator, rows, rows_in, output_bytes, invocations}}."""
+        rows = {
+            k - _STATS_ROWS_BASE: int(v)
+            for k, v in required.items()
+            if isinstance(k, int) and k >= _STATS_ROWS_BASE
+        }
+        stats: dict[int, dict] = {}
+        for nid, node in nodes.items():
+            if nid not in rows:
+                continue  # CSE-reused subtree interiors carry no counter
+            child_rows = [rows[c] for c in _child_ids(nodes, nid) if c in rows]
+            stats[nid] = {
+                "operator": type(node).__name__,
+                "rows": rows[nid],
+                "rows_in": sum(child_rows) if child_rows else rows[nid],
+                "output_bytes": rows[nid] * _est_row_bytes(node),
+                "invocations": 1,
+            }
+        self.last_operator_stats = stats
+        self.last_execute_wall_ms = wall_ms
+
+    def explain_analyze(
+        self, plan: PlanNode, remote_pages: Optional[dict[int, Page]] = None
+    ) -> tuple[Page, dict]:
         """Execute with per-operator observability (the reference's
         OperatorStats rolled up by ExplainAnalyzeOperator).
 
@@ -483,11 +535,13 @@ class LocalExecutor:
         Per-operator wall time comes from an eager pass with a block-until-
         ready hook after every node — dispatch overhead inflates absolute
         numbers, but relative attribution identifies the slow operator; the
-        row counts come from the jitted run and are exact."""
+        row counts come from the jitted run and are exact.  `remote_pages`
+        lets worker tasks analyze fragments with RemoteSource leaves
+        (distributed EXPLAIN ANALYZE, runtime/worker.py)."""
         import time
 
         # ensure capacities are learned + result correct (jitted path)
-        page = self.execute(plan)
+        page = self.execute(plan, remote_pages)
         caps = self._learned_caps[plan]
         nodes = _node_ids(plan)
         inputs = {}
@@ -496,6 +550,8 @@ class LocalExecutor:
                 inputs[str(i)] = self.table_page(
                     n.catalog, n.table, n.column_names, n.output_types, scan_id=i
                 )
+            elif isinstance(n, RemoteSource):
+                inputs[str(i)] = remote_pages[n.fragment_id]
         stats: dict[int, dict] = {}
 
         last = [time.perf_counter()]
@@ -508,13 +564,17 @@ class LocalExecutor:
 
         _, required = _trace_plan(plan, inputs, caps, node_hook=hook, collect_stats=True)
         for key, val in required.items():
-            if isinstance(key, tuple) and key[0] == "rows":
-                stats.setdefault(key[1], {})["rows"] = int(val)
+            if isinstance(key, int) and key >= _STATS_ROWS_BASE:
+                stats.setdefault(key - _STATS_ROWS_BASE, {})["rows"] = int(val)
         return page, stats
 
     def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
-        cache_key = (plan, tuple(sorted(caps.items())),
+        collect = self.collect_operator_stats
+        cache_key = (plan, collect, tuple(sorted(caps.items())),
                      tuple(sorted((k, p.capacity) for k, p in inputs.items())))
+        _JIT_CACHE_LOOKUPS.labels(
+            "hit" if cache_key in self._jit_cache else "miss"
+        ).inc()
         if cache_key not in self._jit_cache:
             # pack every overflow counter into ONE int64 vector inside the
             # jit: on a tunneled TPU each device->host transfer is a full
@@ -524,7 +584,7 @@ class LocalExecutor:
             holder: dict = {"keys": None}
 
             def call(pages, _holder=holder):
-                out_page, req = _trace_plan(plan, pages, caps)
+                out_page, req = _trace_plan(plan, pages, caps, collect_stats=collect)
                 keys = sorted(req, key=repr)
                 _holder["keys"] = keys
                 packed = (
@@ -540,6 +600,35 @@ class LocalExecutor:
         vals = np.asarray(packed)  # ONE device->host transfer
         required = dict(zip(holder["keys"], vals.tolist()))
         return out_page, required
+
+
+def _est_row_bytes(node: PlanNode) -> int:
+    """Nominal output-row width for the stats pipeline's output_bytes
+    estimate (strings count as 16B dictionary-coded payload + pointer)."""
+    total = 0
+    try:
+        types = node.output_types
+    except Exception:
+        return 8
+    for t in types:
+        if getattr(t, "is_string", False):
+            total += 16
+        else:
+            try:
+                total += int(np.dtype(t.np_dtype).itemsize)
+            except Exception:
+                total += 8
+        total += 1  # validity mask byte
+    return max(total, 1)
+
+
+from ..utils.metrics import GLOBAL as _METRICS
+
+_JIT_CACHE_LOOKUPS = _METRICS.counter(
+    "trino_tpu_jit_cache_lookups_total",
+    "Fragment jit-program cache lookups in LocalExecutor._run",
+    ("result",),
+)
 
 
 def _has_host_aggs(plan: PlanNode) -> bool:
@@ -580,8 +669,10 @@ def _trace_plan(
     overflow counters are pmax-reduced so every device agrees on retries.
 
     collect_stats: also report each node's live output-row count under the
-    key ("rows", nid) in `required` — the per-operator row stats EXPLAIN
-    ANALYZE renders (reference: OperatorStats via OperatorContext).
+    int key `_STATS_ROWS_BASE + nid` in `required` — the per-operator row
+    stats EXPLAIN ANALYZE renders (reference: OperatorStats via
+    OperatorContext).  Under shard_map the counts are psum-reduced, so a
+    distributed stage's row count is the sum over its shards.
     node_hook(nid, node, stage): called after each node emits; in eager
     (non-jit) execution the hook can block_until_ready for wall-clock
     attribution per operator."""
@@ -602,6 +693,12 @@ def _trace_plan(
         if axis is not None and num_devices > 1:
             value = jax.lax.pmax(value, axis)
         required[nid] = value
+
+    def count_rows(nid_here: int, live) -> None:
+        cnt = jnp.sum(live.astype(jnp.int64))
+        if axis is not None and num_devices > 1:
+            cnt = jax.lax.psum(cnt, axis)
+        required[_STATS_ROWS_BASE + nid_here] = cnt
 
     def _scan_offsets(node: PlanNode) -> tuple[int, ...]:
         # pre-order offsets of the leaf nodes that read pages[str(nid)]
@@ -630,6 +727,8 @@ def _trace_plan(
                 for off in offsets
             ):
                 counter[0] += len(_node_ids(node))
+                if collect_stats:
+                    count_rows(nid_here, stage_c.live)
                 return _Stage(
                     [
                         ColumnVal(cv.data, cv.valid, cv.dict, cv.type, cv.data2)
@@ -641,7 +740,7 @@ def _trace_plan(
         if hashable:
             memo[node] = (stage, _scan_offsets(node), nid_here)
         if collect_stats:
-            required[("rows", nid_here)] = jnp.sum(stage.live.astype(jnp.int64))
+            count_rows(nid_here, stage.live)
         if node_hook is not None:
             node_hook(nid_here, node, stage)
         return stage

@@ -40,6 +40,15 @@ from .compiler import LocalExecutor, _node_ids
 
 __all__ = ["OutOfCoreExecutor", "estimate_plan_bytes"]
 
+from ..utils.metrics import GLOBAL as _METRICS
+
+_SPILL_BYTES = _METRICS.counter(
+    "trino_tpu_spill_bytes_total", "Bytes written to spill files"
+)
+_SPILL_FILES = _METRICS.counter(
+    "trino_tpu_spill_files_total", "Spill chunk files written"
+)
+
 
 def estimate_plan_bytes(plan: PlanNode, catalogs: CatalogManager) -> int:
     """Upper-bound estimate of device bytes for single-shot execution:
@@ -105,6 +114,8 @@ class OutOfCoreExecutor:
                     fh.write(blob)
                 self.spilled_bytes += len(blob)
                 self.spill_files += 1
+                _SPILL_BYTES.inc(len(blob))
+                _SPILL_FILES.inc()
                 paths.append(path)
             spill[key] = paths
 

@@ -124,10 +124,30 @@ class SpmdExecutor(LocalExecutor):
             }
             if not overflow:
                 self._learned_caps[plan] = caps
+                if self.collect_operator_stats:
+                    jax.block_until_ready([c.data for c in out_page.columns])
+                    self._record_operator_stats(nodes, required)
                 return out_page
             for nid, req in overflow.items():
                 caps[nid] = _pow2(max(req, caps[nid] * 2))
         raise RuntimeError(f"capacity retry loop did not converge: {caps}")
+
+    def explain_analyze(self, plan: PlanNode, remote_pages=None):
+        """SPMD EXPLAIN ANALYZE: the whole plan is ONE fused program, so
+        per-operator wall time is not separable — but exact per-operator row
+        counts (psum-reduced over shards) come out of the compiled run.
+        Returns (page, stats) with stats[nid] = {"rows": int}."""
+        prev = self.collect_operator_stats
+        self.collect_operator_stats = True
+        try:
+            page = self.execute(plan)
+        finally:
+            self.collect_operator_stats = prev
+        stats = {
+            nid: {"rows": s["rows"]}
+            for nid, s in self.last_operator_stats.items()
+        }
+        return page, stats
 
     def _initial_caps_spmd(self, nodes, inputs) -> dict[int, int]:
         """Like LocalExecutor._initial_caps but sizes are per-device and
@@ -209,9 +229,10 @@ class SpmdExecutor(LocalExecutor):
 
         D = self.num_devices
         mesh = self.mesh
+        collect = self.collect_operator_stats
 
         def step(pages):
-            return _trace_plan(plan, pages, caps, D, AXIS)
+            return _trace_plan(plan, pages, caps, D, AXIS, collect_stats=collect)
 
         def smap(fn):
             try:
@@ -229,7 +250,7 @@ class SpmdExecutor(LocalExecutor):
             out_page, required = smap(step)(inputs)
             return out_page, jax.device_get(required)
 
-        cache_key = ("spmd", plan, tuple(sorted(caps.items())),
+        cache_key = ("spmd", plan, collect, tuple(sorted(caps.items())),
                      tuple(sorted((k, p.capacity) for k, p in inputs.items())))
         if cache_key not in self._jit_cache:
             smapped = smap(step)

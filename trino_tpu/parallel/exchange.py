@@ -32,9 +32,33 @@ __all__ = ["repartition", "gather_all", "AXIS"]
 
 AXIS = "workers"
 
+from ..utils.metrics import GLOBAL as _METRICS
+
+# host-side, trace-time accounting: shapes are static, so the planned
+# per-device collective payload is known when the exchange is traced.
+# Incremented once per compiled program, not per dispatch.
+_EXCHANGE_PLANNED_BYTES = _METRICS.counter(
+    "trino_tpu_spmd_exchange_planned_bytes_total",
+    "Per-device collective payload bytes planned at trace time",
+    ("kind",),
+)
+
+
+def _planned_bytes(cols: Sequence[ColumnVal], live: jnp.ndarray) -> int:
+    total = int(live.shape[0])  # the live mask itself (1B bool lanes)
+    for cv in cols:
+        lanes = int(cv.data.shape[0])
+        total += lanes * cv.data.dtype.itemsize
+        if cv.valid is not None:
+            total += lanes
+        if cv.data2 is not None:
+            total += lanes * cv.data2.dtype.itemsize
+    return total
+
 
 def gather_all(cols: Sequence[ColumnVal], live: jnp.ndarray, axis: str = AXIS):
     """Replicate the local shard to every device (broadcast/gather)."""
+    _EXCHANGE_PLANNED_BYTES.labels("gather").inc(_planned_bytes(cols, live))
     out_cols = []
     for cv in cols:
         data = _flatten_gather(cv.data, axis)
@@ -66,6 +90,7 @@ def repartition(
     n = live.shape[0]
     D = num_devices
     B = bucket_capacity
+    _EXCHANGE_PLANNED_BYTES.labels("repartition").inc(_planned_bytes(cols, live))
 
     h = _combined_hash(keys, live, n, sentinel=0)
     part = jnp.where(live, h % D, 0).astype(jnp.int32)

@@ -23,6 +23,7 @@ __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "AggCall",
     "Join", "Sort", "SortKey", "TopN", "Limit", "Distinct", "Values",
     "Exchange", "Unnest", "EnforceSingleRow", "MatchRecognize", "Compact",
+    "format_plan", "plan_to_obj", "walk",
 ]
 
 
@@ -539,6 +540,41 @@ def walk(node: PlanNode):
         yield from walk(c)
 
 
+def _node_detail(node: PlanNode) -> str:
+    """Per-operator detail string shared by the text (format_plan) and JSON
+    (plan_to_obj) EXPLAIN renderers."""
+    if isinstance(node, TableScan):
+        return f" {node.catalog}.{node.table} {list(node.column_names)}"
+    if isinstance(node, Filter):
+        return f" [{node.predicate}]"
+    if isinstance(node, Project):
+        return f" {[f'{n}={e}' for n, e in zip(node.names, node.expressions)]}"
+    if isinstance(node, Aggregate):
+        return f" step={node.step} keys={[str(k) for k in node.group_keys]} aggs={[f'{a.fn}({a.arg})' for a in node.aggs]}"
+    if isinstance(node, Join):
+        return (
+            f" {node.kind} {node.distribution} on "
+            f"{[f'{l}={r}' for l, r in zip(node.left_keys, node.right_keys)]}"
+            + (f" residual=[{node.residual}]" if node.residual is not None else "")
+        )
+    if isinstance(node, (Sort, TopN)):
+        detail = f" keys={[(str(k.expr), 'asc' if k.ascending else 'desc') for k in node.keys]}"
+        if isinstance(node, TopN):
+            detail += f" count={node.count}"
+        return detail
+    if isinstance(node, Limit):
+        return f" count={node.count}"
+    if isinstance(node, Exchange):
+        return f" {node.kind}" + (
+            f" keys={[str(k) for k in node.keys]}" if node.keys else ""
+        )
+    if isinstance(node, Unnest):
+        return f" {[str(a) for a in node.arrays]}" + (
+            " with ordinality" if node.with_ordinality else ""
+        ) + (" outer" if node.outer else "")
+    return ""
+
+
 def format_plan(
     node: PlanNode,
     indent: int = 0,
@@ -554,37 +590,33 @@ def format_plan(
     _counter[0] += 1
     pad = "  " * indent
     label = type(node).__name__
-    detail = ""
-    if isinstance(node, TableScan):
-        detail = f" {node.catalog}.{node.table} {list(node.column_names)}"
-    elif isinstance(node, Filter):
-        detail = f" [{node.predicate}]"
-    elif isinstance(node, Project):
-        detail = f" {[f'{n}={e}' for n, e in zip(node.names, node.expressions)]}"
-    elif isinstance(node, Aggregate):
-        detail = f" step={node.step} keys={[str(k) for k in node.group_keys]} aggs={[f'{a.fn}({a.arg})' for a in node.aggs]}"
-    elif isinstance(node, Join):
-        detail = (
-            f" {node.kind} {node.distribution} on "
-            f"{[f'{l}={r}' for l, r in zip(node.left_keys, node.right_keys)]}"
-            + (f" residual=[{node.residual}]" if node.residual is not None else "")
-        )
-    elif isinstance(node, (Sort, TopN)):
-        detail = f" keys={[(str(k.expr), 'asc' if k.ascending else 'desc') for k in node.keys]}"
-        if isinstance(node, TopN):
-            detail += f" count={node.count}"
-    elif isinstance(node, Limit):
-        detail = f" count={node.count}"
-    elif isinstance(node, Exchange):
-        detail = f" {node.kind}" + (
-            f" keys={[str(k) for k in node.keys]}" if node.keys else ""
-        )
-    elif isinstance(node, Unnest):
-        detail = f" {[str(a) for a in node.arrays]}" + (
-            " with ordinality" if node.with_ordinality else ""
-        ) + (" outer" if node.outer else "")
     suffix = annotations.get(nid, "") if annotations else ""
-    lines = [f"{pad}{label}{detail}{suffix}"]
+    lines = [f"{pad}{label}{_node_detail(node)}{suffix}"]
     for c in node.children:
         lines.append(format_plan(c, indent + 1, annotations, _counter))
     return "\n".join(lines)
+
+
+def plan_to_obj(
+    node: PlanNode,
+    stats: "Optional[dict[int, dict]]" = None,
+    _counter: "Optional[list[int]]" = None,
+) -> dict:
+    """JSON-shaped EXPLAIN rendering (session property explain_format=json;
+    reference: sql/planner/planprinter/JsonRenderer).  Node ids use the
+    same preorder numbering as format_plan/_node_ids, so `stats` from
+    EXPLAIN ANALYZE attach per operator."""
+    if _counter is None:
+        _counter = [0]
+    nid = _counter[0]
+    _counter[0] += 1
+    obj: dict = {
+        "id": nid,
+        "operator": type(node).__name__,
+        "detail": _node_detail(node).strip(),
+        "outputs": [str(n) for n in node.output_names],
+    }
+    if stats and nid in stats:
+        obj["stats"] = stats[nid]
+    obj["children"] = [plan_to_obj(c, stats, _counter) for c in node.children]
+    return obj

@@ -38,6 +38,9 @@ from ..plan.fragmenter import Fragment, fragment_plan
 from ..plan.optimizer import optimize
 from ..plan.planner import Planner
 from ..plan.serde import _encode, plan_to_json
+from ..utils import metrics as _metrics
+from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
+from .events import EventListenerManager, QueryEvent
 from .failure import Backoff, FailureDetector
 from .session import SessionProperties
 from .spool import SPOOL_URL, SpooledExchange
@@ -95,11 +98,46 @@ class Coordinator:
         self.memory_requeues = 0  # memory kills degraded to out-of-core
         self._lock = threading.Lock()
         self.heartbeat_interval = heartbeat_interval
+        # coordinator control-plane metrics, exposed at GET /metrics in
+        # Prometheus text format (reference: the JMX->/v1/jmx/mbean surface
+        # ClusterStatsResource reads; ours is the standard exposition)
+        self.metrics = _metrics.MetricsRegistry()
+        self._m_queries = self.metrics.counter(
+            "trino_tpu_queries_total", "Queries reaching a terminal state",
+            ("state",),
+        )
+        self._m_running = self.metrics.gauge(
+            "trino_tpu_queries_running", "Tracked queries not yet terminal"
+        )
+        self._m_dispatched = self.metrics.counter(
+            "trino_tpu_tasks_dispatched_total", "Task POSTs sent to workers"
+        )
+        self._m_retries = self.metrics.counter(
+            "trino_tpu_task_retries_total",
+            "Task re-schedules under retry_policy=TASK",
+        )
+        self._m_heals = self.metrics.counter(
+            "trino_tpu_task_heals_total",
+            "Dead-producer recoveries (spool re-point or recompute)",
+        )
+        self._m_breaker = self.metrics.counter(
+            "trino_tpu_circuit_breaker_transitions_total",
+            "Worker circuit-breaker state changes", ("to",),
+        )
+        self._m_query_seconds = self.metrics.histogram(
+            "trino_tpu_query_seconds", "End-to-end query wall seconds"
+        )
+        # query lifecycle events (reference: EventListener SPI fired from
+        # QueryMonitor on the coordinator, not the workers)
+        self.events = EventListenerManager()
+        self.tracer = Tracer()
+        add_exporters_from_env(self.tracer)
         # per-worker circuit breaker fed by heartbeat outcomes (reference:
         # HeartbeatFailureDetector.java:76); quarantined workers receive no
         # new dispatches and are half-open probed for automatic recovery
         self.failure_detector = FailureDetector(
-            probe_interval=heartbeat_interval * 2
+            probe_interval=heartbeat_interval * 2,
+            on_transition=lambda url, old, new: self._m_breaker.labels(new).inc(),
         )
         # finished queries older than this are expired (record + spooled
         # segments GC'd) by the heartbeat sweep; 0 disables
@@ -118,6 +156,18 @@ class Coordinator:
         for t in self._threads:
             t.start()
         return self
+
+    def add_event_listener(self, listener) -> None:
+        """Reference: EventListener SPI (eventlistener/EventListenerManager)."""
+        self.events.add(listener)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition: coordinator instruments plus the
+        process-global registry (spill/compile-cache counters)."""
+        with self._lock:
+            running = sum(1 for r in self.queries.values() if not r["sm"].done)
+        self._m_running.set(running)
+        return self.metrics.render(extra=_metrics.GLOBAL)
 
     def stop(self) -> None:
         self._hb_stop.set()
@@ -267,19 +317,26 @@ class Coordinator:
         """Run a query without resource-group admission — for SELECTs nested
         inside an already-admitted statement (CTAS / INSERT...SELECT), which
         would deadlock against their own group's concurrency slot."""
+        return self._execute_unmanaged_record(sql)["result"]
+
+    def _execute_unmanaged_record(self, sql, analyze: bool = False) -> dict:
+        """Unmanaged run returning the full query record — EXPLAIN ANALYZE
+        needs record["query_info"] (per-stage operator stats), not just the
+        rows.  analyze=True makes every task time its operators eagerly."""
         qid = f"q_{uuid.uuid4().hex[:12]}"
         sm = QueryStateMachine(qid)
         record = {
             "sm": sm, "sql": sql, "result": None, "columns": None,
             "done": threading.Event(),
             "spooled": False,  # nested statements always return rows inline
+            "analyze": analyze,
         }
         with self._lock:
             self.queries[qid] = record
         self._run(record)
         if sm.state == "FAILED":
             raise RuntimeError(sm.error)
-        return record["result"]
+        return record
 
     def expire_query(self, qid: str) -> None:
         """Forget a finished query and GC its spooled result segments."""
@@ -306,6 +363,40 @@ class Coordinator:
         return True
 
     def _run(self, record: dict) -> None:
+        """Lifecycle shell around one query: opens the query trace span
+        (whose traceparent every task POST carries), fires created/
+        completed/failed events, and feeds the query metrics.  The actual
+        scheduling lives in _run_inner."""
+        sm: QueryStateMachine = record["sm"]
+        sql_text = record["sql"] if isinstance(record["sql"], str) else "<planned>"
+        self.events.fire(QueryEvent("created", sm.query_id, sql_text))
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span("query", query_id=sm.query_id) as qspan:
+                record["trace_id"] = qspan.trace_id
+                record["traceparent"] = traceparent(qspan)
+                self._run_inner(record)
+                self.tracer.annotate(state=sm.state)
+        finally:
+            wall = time.perf_counter() - t0
+            self._m_query_seconds.observe(wall)
+            self._m_queries.labels(sm.state).inc()
+            qi = record.get("query_info") or {}
+            self.events.fire(
+                QueryEvent(
+                    "completed" if sm.state == "FINISHED" else "failed",
+                    sm.query_id,
+                    sql_text,
+                    wall,
+                    rows=len(record["result"] or []),
+                    error=sm.error,
+                    cpu_ms=float(qi.get("cpu_ms") or 0.0),
+                    peak_memory_bytes=int(qi.get("peak_memory_bytes") or 0),
+                    stage_count=int(qi.get("stage_count") or 0),
+                )
+            )
+
+    def _run_inner(self, record: dict) -> None:
         sm: QueryStateMachine = record["sm"]
         # full statement surface on the coordinator (reference: the
         # DataDefinitionTask family executes DDL coordinator-side while
@@ -472,6 +563,8 @@ class Coordinator:
                 if u != SPOOL_URL and not self._worker_alive(u)
             ]
             for i in dead:
+                self._m_heals.inc()
+                record["task_heals"] = record.get("task_heals", 0) + 1
                 if spool is not None and spool.is_committed(urls_list[i][1]):
                     urls_list[i] = (SPOOL_URL, urls_list[i][1])
                     moved = True
@@ -527,6 +620,10 @@ class Coordinator:
                 "memory_budget_bytes": int(
                     self.session.get("task_memory_budget_bytes") or 0
                 ) or None,
+                # workers join the query's trace and, under EXPLAIN ANALYZE,
+                # time each operator eagerly
+                "traceparent": record.get("traceparent"),
+                "analyze": bool(record.get("analyze")),
             }
             tag = f"{sm.query_id}_a{attempt}_f{f.id}"
             frag_meta[f.id] = (payload_base, tag)
@@ -560,6 +657,9 @@ class Coordinator:
                     (record.get("kill_reason") or "Query was canceled")
                     if record.get("cancel")
                     else None
+                ),
+                on_retry=lambda: record.__setitem__(
+                    "task_retries", record.get("task_retries", 0) + 1
                 ),
             )
             task_urls[f.id] = urls
@@ -623,6 +723,8 @@ class Coordinator:
 
             root = frag_by_id[0]
             executor = LocalExecutor(self.catalogs, self.default_catalog)
+            # the root stage reports operator stats like any worker task
+            executor.collect_operator_stats = True
             if record.get("cancel"):  # e.g. memory kill during the stages
                 raise RuntimeError(
                     record.get("kill_reason") or "Query was canceled"
@@ -656,14 +758,140 @@ class Coordinator:
                     blobs, list(child.root.output_types)
                 )
             sm.transition("FINISHING")
-            page = executor.execute(root.root, remote_pages)
+            if record.get("analyze"):
+                page, root_an = executor.explain_analyze(root.root, remote_pages)
+                for nid, s in root_an.items():
+                    if "ms" in s:
+                        executor.last_operator_stats.setdefault(nid, {})["ms"] = (
+                            round(s["ms"], 3)
+                        )
+            else:
+                page = executor.execute(root.root, remote_pages)
             record["result"] = page.to_pylist()
+            # stats are pulled from the workers BEFORE cleanup deletes the
+            # tasks; a stats failure must never fail a finished query
+            try:
+                self._collect_query_info(
+                    record, fragments, ntasks, task_urls, executor,
+                    stage_times, t_query0,
+                )
+            except Exception:
+                traceback.print_exc()
             if record.get("spooled"):
                 self._spool_result(sm.query_id, record)
         finally:
             self._cleanup_tasks(all_tasks)
             if spool is not None:  # committed stage output dies with the query
                 spool.remove_query(sm.query_id)
+
+    # ------------------------------------------------------------ QueryInfo
+    def _collect_query_info(
+        self, record, fragments, ntasks, task_urls, root_executor,
+        stage_times, t_query0,
+    ) -> None:
+        """Aggregate per-task operator stats into record["query_info"] — the
+        coordinator's QueryInfo (reference: QueryStats + StageStats +
+        OperatorStats assembled by QueryStateMachine.getQueryInfo).  Each
+        stage carries its plan annotated with summed per-operator rows (and
+        eager ms under EXPLAIN ANALYZE), its task list, and its wall
+        interval; query-wide rollups (cpu_ms = sum of task wall,
+        peak_memory_bytes = largest task output) feed the completion event."""
+        from ..plan.nodes import format_plan
+
+        sm: QueryStateMachine = record["sm"]
+        stages = []
+        cpu_ms = 0.0
+        peak_mem = 0
+        for f in sorted(fragments, key=lambda fr: fr.id):
+            ops: dict[int, dict] = {}
+            task_infos = []
+            if f.output_kind == "result":
+                for nid, s in root_executor.last_operator_stats.items():
+                    ops[int(nid)] = dict(s)
+                wall = root_executor.last_execute_wall_ms or 0.0
+                task_infos.append(
+                    {"worker": "coordinator", "task_id": f"{sm.query_id}_root",
+                     "wall_ms": round(wall, 3)}
+                )
+                cpu_ms += wall
+            else:
+                for (url, task_id) in task_urls.get(f.id, []):
+                    if url == SPOOL_URL:
+                        task_infos.append(
+                            {"worker": SPOOL_URL, "task_id": task_id}
+                        )
+                        continue
+                    st = self._task_info(url, task_id).get("stats") or {}
+                    ti = {
+                        "worker": url,
+                        "task_id": task_id,
+                        "wall_ms": st.get("wall_ms"),
+                        "rows_out": st.get("rows_out"),
+                        "output_bytes": st.get("output_bytes"),
+                        "exchange_bytes_fetched": st.get("exchange_bytes_fetched"),
+                        "exchange_bytes_served": st.get("exchange_bytes_served"),
+                        "rows_pruned": st.get("rows_pruned"),
+                    }
+                    task_infos.append(ti)
+                    cpu_ms += float(st.get("wall_ms") or 0.0)
+                    peak_mem = max(peak_mem, int(st.get("output_bytes") or 0))
+                    for nid_s, s in (st.get("operators") or {}).items():
+                        nid = int(nid_s)
+                        agg = ops.get(nid)
+                        if agg is None:
+                            ops[nid] = dict(s)
+                            continue
+                        # tasks partition the stage's rows: counts SUM; eager
+                        # per-operator ms also sums (cluster CPU, like the
+                        # reference's driver-summed OperatorStats)
+                        for k in ("rows", "rows_in", "output_bytes",
+                                  "invocations"):
+                            if k in s:
+                                agg[k] = agg.get(k, 0) + s[k]
+                        if "ms" in s:
+                            agg["ms"] = round(agg.get("ms", 0.0) + s["ms"], 3)
+            ann = {
+                nid: (
+                    f"   [rows: {s['rows']}"
+                    + (f", {s['ms']:.1f} ms" if "ms" in s else "")
+                    + "]"
+                )
+                for nid, s in ops.items()
+                if "rows" in s
+            }
+            stages.append(
+                {
+                    "stage_id": f.id,
+                    "output_kind": f.output_kind,
+                    "tasks": task_infos,
+                    "operators": {str(n): s for n, s in sorted(ops.items())},
+                    "plan": format_plan(f.root, annotations=ann).splitlines(),
+                    "wall_interval_s": stage_times.get(f.id),
+                }
+            )
+        record["query_info"] = {
+            "query_id": sm.query_id,
+            "stages": stages,
+            "stage_count": len(stages),
+            "cpu_ms": round(cpu_ms, 3),
+            "peak_memory_bytes": peak_mem,
+            "wall_ms": round((time.perf_counter() - t_query0) * 1e3, 3),
+            "output_rows": len(record["result"] or []),
+            "task_retries": record.get("task_retries", 0),
+            "task_heals": record.get("task_heals", 0),
+            "trace_id": record.get("trace_id", ""),
+            "workers": self.failure_detector.snapshot(),
+        }
+
+    def _task_info(self, worker_url: str, task_id: str) -> dict:
+        """Full task-status JSON (state + stats); {} when unreachable."""
+        try:
+            with urllib.request.urlopen(
+                f"{worker_url}/v1/task/{task_id}/status", timeout=5
+            ) as r:
+                return json.loads(r.read())
+        except Exception:
+            return {}
 
     # --------------------------------------------- spooled client protocol
     _SPOOL_SEGMENT_ROWS = 65536
@@ -738,6 +966,7 @@ class Coordinator:
         posted: Optional[list] = None,
         refresh_sources=None,
         should_abort=None,
+        on_retry=None,
     ) -> list[tuple[str, str]]:
         """Post one stage's tasks, poll statuses, and re-schedule individual
         failures onto other alive workers (task-level recovery).  Every
@@ -791,6 +1020,9 @@ class Coordinator:
                         raise RuntimeError(
                             f"task {pending[p][1]} failed {attempts[p]} times"
                         )
+                    self._m_retries.inc()
+                    if on_retry is not None:
+                        on_retry()
                     bad_url = pending[p][0]
                     if state == "UNREACHABLE":
                         # feed the circuit breaker so repeated unreachability
@@ -907,6 +1139,7 @@ class Coordinator:
         return out
 
     def _post_task(self, worker_url: str, payload: dict) -> None:
+        self._m_dispatched.inc()
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
             f"{worker_url}/v1/task/{payload['task_id']}",
@@ -970,6 +1203,24 @@ def _statement_surface(coord: "Coordinator"):
         def query(self, sql_or_query) -> list[tuple]:
             # unmanaged: the enclosing statement already holds the group slot
             return self._coord._execute_query_unmanaged(sql_or_query)
+
+        def _explain_analyze_distributed(self, query):
+            """Distributed EXPLAIN ANALYZE: run through the scheduler with
+            per-task operator timing and return the coordinator QueryInfo.
+            Raises — never silently degrades to a stats-less plan — when a
+            stage comes back without operator stats."""
+            record = self._coord._execute_unmanaged_record(query, analyze=True)
+            info = record.get("query_info")
+            if info is None:
+                raise RuntimeError(
+                    "distributed EXPLAIN ANALYZE produced no operator stats"
+                )
+            for st in info["stages"]:
+                if not st.get("operators"):
+                    raise RuntimeError(
+                        f"stage {st['stage_id']} returned no operator stats"
+                    )
+            return info
 
         def _query_columns(self, query):
             plan = self.plan(query)
@@ -1066,18 +1317,34 @@ def _make_handler(coord: Coordinator):
                 # self-refreshing page over the same coordinator state)
                 import html as _html
 
+                now = time.time()
+
+                def _age(sm: QueryStateMachine) -> str:
+                    wall = (sm.finished_at or now) - sm.created_at
+                    in_state = now - sm.state_changed_at
+                    return (
+                        f"<td>{wall:.1f}</td><td>{in_state:.1f}</td>"
+                    )
+
+                # both tables snapshot under the lock: workers and queries
+                # mutate from the heartbeat/announce threads, and iterating
+                # a mutating dict here raced (RuntimeError mid-render)
                 with coord._lock:
                     qrows = "".join(
                         f"<tr><td>{_html.escape(str(qid))}</td>"
                         f"<td>{_html.escape(rec['sm'].state)}</td>"
+                        f"{_age(rec['sm'])}"
                         f"<td><code>{_html.escape(str(rec.get('sql'))[:120])}</code></td></tr>"
                         for qid, rec in list(coord.queries.items())[-50:]
                     )
-                wrows = "".join(
-                    f"<tr><td>{_html.escape(w.url)}</td>"
-                    f"<td>{'alive' if w.alive else 'dead'}</td></tr>"
-                    for w in coord.workers.values()
-                )
+                    wrows = "".join(
+                        f"<tr><td>{_html.escape(w.url)}</td>"
+                        f"<td>{'alive' if w.alive else 'dead'}</td>"
+                        f"<td>{now - w.last_seen:.1f}</td></tr>"
+                        for w in list(coord.workers.values())
+                    )
+                    nworkers = len(coord.workers)
+                    nqueries = len(coord.queries)
                 body = (
                     "<!doctype html><html><head><meta charset='utf-8'>"
                     "<meta http-equiv='refresh' content='3'>"
@@ -1085,14 +1352,26 @@ def _make_handler(coord: Coordinator):
                     "margin:2em}table{border-collapse:collapse}td,th{border:1px "
                     "solid #999;padding:4px 8px}</style></head><body>"
                     "<h2>trino_tpu coordinator</h2>"
-                    f"<h3>workers ({len(coord.workers)})</h3>"
-                    f"<table><tr><th>url</th><th>state</th></tr>{wrows}</table>"
-                    f"<h3>queries ({len(coord.queries)})</h3>"
-                    "<table><tr><th>id</th><th>state</th><th>sql</th></tr>"
+                    f"<h3>workers ({nworkers})</h3>"
+                    "<table><tr><th>url</th><th>state</th><th>seen (s)</th>"
+                    f"</tr>{wrows}</table>"
+                    f"<h3>queries ({nqueries})</h3>"
+                    "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
+                    "<th>in state (s)</th><th>sql</th></tr>"
                     f"{qrows}</table></body></html>"
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if parts[:1] == ["metrics"]:
+                body = coord.metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -1109,6 +1388,25 @@ def _make_handler(coord: Coordinator):
                         "resource_groups": coord.resource_groups.stats(),
                     },
                 )
+            if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                # QueryInfo: stages, tasks, operator stats, retry counters
+                # (reference: server QueryResource GET /v1/query/{queryId})
+                with coord._lock:
+                    record = coord.queries.get(parts[2])
+                if record is None:
+                    return self._send_json(404, {"error": "unknown query"})
+                info = dict(record.get("query_info") or {})
+                info.update(
+                    {
+                        "query_id": parts[2],
+                        "state": record["sm"].state,
+                        "error": record["sm"].error,
+                        "task_retries": record.get("task_retries", 0),
+                        "task_heals": record.get("task_heals", 0),
+                        "stage_times": record.get("stage_times") or {},
+                    }
+                )
+                return self._send_json(200, info)
             if parts[:2] == ["v1", "query"] and len(parts) >= 4 and parts[3] == "state":
                 # cheap state probe: never serializes result rows
                 with coord._lock:

@@ -100,10 +100,11 @@ class Engine:
         # reference: security/AccessControlManager consulted before planning
         self.access_control = AllowAllAccessControl()
         self.user = "user"
-        from ..utils.tracing import Tracer
+        from ..utils.tracing import Tracer, add_exporters_from_env
 
         # reference: OpenTelemetry spans (SqlQueryExecution.java:473)
         self.tracer = Tracer()
+        add_exporters_from_env(self.tracer)
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -237,8 +238,12 @@ class Engine:
                 QueryEvent("failed", qid, text, _time.perf_counter() - t0, error=str(e))
             )
             raise
+        wall = _time.perf_counter() - t0
         self.events.fire(
-            QueryEvent("completed", qid, text, _time.perf_counter() - t0, rows=len(rows))
+            QueryEvent(
+                "completed", qid, text, wall, rows=len(rows),
+                cpu_ms=round(wall * 1e3, 3), stage_count=1,
+            )
         )
         return rows
 
@@ -292,40 +297,7 @@ class Engine:
             return self.query(stmt.query)
 
         if isinstance(stmt, S.Explain):
-            plan = self.plan(stmt.query)
-            if not stmt.analyze:
-                return [(line,) for line in format_plan(plan).splitlines()]
-            t0 = _time.perf_counter()
-            if not self.distributed and hasattr(self.executor, "explain_analyze"):
-                page, stats = self.executor.explain_analyze(plan)
-                wall = _time.perf_counter() - t0
-                ann = {
-                    nid: (
-                        f"   [rows: {s.get('rows', '?')}"
-                        + (f", {s['ms']:.1f} ms" if "ms" in s else "")
-                        + "]"
-                    )
-                    for nid, s in stats.items()
-                }
-                text = format_plan(plan, annotations=ann).splitlines()
-                timed = [(nid, s["ms"]) for nid, s in stats.items() if "ms" in s]
-                if timed:
-                    slow_nid, slow_ms = max(timed, key=lambda kv: kv[1])
-                    from ..exec.compiler import _node_ids
-
-                    slow = type(_node_ids(plan)[slow_nid]).__name__
-                    text.append(
-                        f"-- slowest operator: {slow} (node {slow_nid}, {slow_ms:.1f} ms eager)"
-                    )
-                text.append(
-                    f"-- output rows: {len(page.to_pylist())}, wall: {wall * 1000:.1f} ms"
-                )
-                return [(line,) for line in text]
-            rows = self.query(stmt.query)
-            wall = _time.perf_counter() - t0
-            text = format_plan(plan).splitlines()
-            text.append(f"-- output rows: {len(rows)}, wall: {wall * 1000:.1f} ms")
-            return [(line,) for line in text]
+            return self._execute_explain(stmt)
 
         if isinstance(stmt, S.CreateTable):
             from ..data.types import parse_type
@@ -509,6 +481,115 @@ class Engine:
             return [(1,)]
 
         raise NotImplementedError(f"statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------- explain
+    def _explain_analyze_distributed(self, query):
+        """Override point: the multi-host coordinator surface (runtime/
+        coordinator.py _StatementSurface) returns its QueryInfo — per-stage
+        plans, operator stats, wall intervals — here.  The in-process
+        engine has none and uses the executor path in _execute_explain."""
+        return None
+
+    def _execute_explain(self, stmt) -> list[tuple]:
+        """EXPLAIN [ANALYZE] in the session's explain_format (text | json).
+        ANALYZE prefers the distributed QueryInfo; otherwise any executor
+        with eager per-operator timing (LocalExecutor, SpmdExecutor)."""
+        import json as _json
+
+        from ..plan.nodes import plan_to_obj
+
+        fmt = str(self.session.get("explain_format") or "text").lower()
+        plan = self.plan(stmt.query)
+        if not stmt.analyze:
+            if fmt == "json":
+                return [(_json.dumps(plan_to_obj(plan), indent=2),)]
+            return [(line,) for line in format_plan(plan).splitlines()]
+
+        t0 = _time.perf_counter()
+        info = self._explain_analyze_distributed(stmt.query)
+        if info is not None:
+            wall = _time.perf_counter() - t0
+            if fmt == "json":
+                return [(_json.dumps(info, default=str, indent=2),)]
+            return [
+                (line,) for line in self._render_distributed_analyze(info, wall)
+            ]
+
+        ex = self.executor
+        if self.distributed:
+            from ..exec.compiler import _has_host_aggs
+
+            if _has_host_aggs(plan):
+                ex = self._local_fallback  # plan came back undistributed
+        if hasattr(ex, "explain_analyze"):
+            page, stats = ex.explain_analyze(plan)
+            wall = _time.perf_counter() - t0
+            if fmt == "json":
+                obj = {
+                    "plan": plan_to_obj(plan, stats=stats),
+                    "output_rows": len(page.to_pylist()),
+                    "wall_ms": round(wall * 1e3, 1),
+                }
+                return [(_json.dumps(obj, indent=2),)]
+            ann = {
+                nid: (
+                    f"   [rows: {s.get('rows', '?')}"
+                    + (f", {s['ms']:.1f} ms" if "ms" in s else "")
+                    + "]"
+                )
+                for nid, s in stats.items()
+            }
+            text = format_plan(plan, annotations=ann).splitlines()
+            timed = [(nid, s["ms"]) for nid, s in stats.items() if "ms" in s]
+            if timed:
+                slow_nid, slow_ms = max(timed, key=lambda kv: kv[1])
+                from ..exec.compiler import _node_ids
+
+                slow = type(_node_ids(plan)[slow_nid]).__name__
+                text.append(
+                    f"-- slowest operator: {slow} (node {slow_nid}, {slow_ms:.1f} ms eager)"
+                )
+            text.append(
+                f"-- output rows: {len(page.to_pylist())}, wall: {wall * 1000:.1f} ms"
+            )
+            return [(line,) for line in text]
+        rows = self.query(stmt.query)
+        wall = _time.perf_counter() - t0
+        text = format_plan(plan).splitlines()
+        text.append(f"-- output rows: {len(rows)}, wall: {wall * 1000:.1f} ms")
+        return [(line,) for line in text]
+
+    @staticmethod
+    def _render_distributed_analyze(info: dict, wall_s: float) -> list[str]:
+        """Trino-style per-fragment EXPLAIN ANALYZE text from a coordinator
+        QueryInfo: each stage's annotated plan under a Fragment header with
+        its wall interval, then the slowest operator across all stages."""
+        text: list[str] = []
+        slowest = None  # (ms, operator, stage_id, nid)
+        for st in info.get("stages") or []:
+            hdr = f"Fragment {st['stage_id']} [{st['output_kind']}]"
+            iv = st.get("wall_interval_s")
+            if iv:
+                hdr += f"  wall: {iv[0] * 1e3:.0f}..{iv[1] * 1e3:.0f} ms"
+            hdr += f"  tasks: {len(st.get('tasks') or [])}"
+            text.append(hdr)
+            text.extend("  " + ln for ln in st.get("plan") or [])
+            for nid, s in (st.get("operators") or {}).items():
+                ms = s.get("ms")
+                if ms is not None and (slowest is None or ms > slowest[0]):
+                    slowest = (ms, s.get("operator", "?"), st["stage_id"], nid)
+        if slowest is not None:
+            text.append(
+                f"-- slowest operator: {slowest[1]} (stage {slowest[2]}, "
+                f"node {slowest[3]}, {slowest[0]:.1f} ms eager)"
+            )
+        text.append(
+            f"-- output rows: {info.get('output_rows', 0)}, "
+            f"wall: {wall_s * 1e3:.1f} ms, cluster cpu: "
+            f"{info.get('cpu_ms', 0):.1f} ms, stages: {info.get('stage_count', 0)}, "
+            f"task retries: {info.get('task_retries', 0)}"
+        )
+        return text
 
     def _target_conn(self, name: str):
         """Resolve a possibly `catalog.table`-qualified DDL/DML target

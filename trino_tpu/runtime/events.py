@@ -20,6 +20,12 @@ class QueryEvent:
     wall_s: float = 0.0
     rows: int = 0
     error: Optional[str] = None
+    # resource accounting (reference: QueryStatistics on QueryCompletedEvent):
+    # cpu_ms sums task wall time across the cluster (> wall_s when stages
+    # overlap), peak_memory_bytes is the largest per-task output footprint
+    cpu_ms: float = 0.0
+    peak_memory_bytes: int = 0
+    stage_count: int = 0
     ts: float = field(default_factory=time.time)
 
 

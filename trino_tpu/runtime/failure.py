@@ -130,6 +130,7 @@ class FailureDetector:
         quarantine_failures: int = 2,
         probe_interval: float = 4.0,
         clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[str, str, str], None]] = None,
     ):
         self.alpha = alpha
         self.suspect_threshold = suspect_threshold
@@ -139,6 +140,16 @@ class FailureDetector:
         self._clock = clock
         self._lock = threading.Lock()
         self._workers: dict[str, WorkerHealth] = {}
+        # (url, old_state, new_state) observer, fired OUTSIDE the lock so a
+        # callback may re-enter the detector (metrics, logging)
+        self._on_transition = on_transition
+
+    def _notify(self, url: str, old: str, new: str) -> None:
+        if old != new and self._on_transition is not None:
+            try:
+                self._on_transition(url, old, new)
+            except Exception:
+                pass  # an observer must never break health accounting
 
     def _get(self, url: str) -> WorkerHealth:
         h = self._workers.get(url)
@@ -154,6 +165,7 @@ class FailureDetector:
     def record_success(self, url: str, latency: float = 0.0) -> None:
         with self._lock:
             h = self._get(url)
+            old = h.state
             h.consecutive_failures = 0
             h.error_ewma *= 1.0 - self.alpha
             h.latency_ewma = (
@@ -169,10 +181,13 @@ class FailureDetector:
                 h.quarantined_at = None
             elif h.state == SUSPECT and h.error_ewma < self.suspect_threshold:
                 h.state = OK
+            new = h.state
+        self._notify(url, old, new)
 
     def record_failure(self, url: str) -> None:
         with self._lock:
             h = self._get(url)
+            old = h.state
             h.consecutive_failures += 1
             h.error_ewma = (1.0 - self.alpha) * h.error_ewma + self.alpha
             h.last_probe_at = self._clock()
@@ -187,6 +202,8 @@ class FailureDetector:
                 h.quarantined_at = self._clock()
             elif h.state == OK:
                 h.state = SUSPECT
+            new = h.state
+        self._notify(url, old, new)
 
     def state(self, url: str) -> str:
         with self._lock:

@@ -36,6 +36,7 @@ class QueryStateMachine:
         self.error: Optional[str] = None
         self.created_at = time.time()
         self.finished_at: Optional[float] = None
+        self.state_changed_at = self.created_at  # /ui "in state for" column
 
     @property
     def state(self) -> str:
@@ -60,6 +61,7 @@ class QueryStateMachine:
             if _ORDER[new_state] <= _ORDER[self._state] and new_state not in TERMINAL:
                 return False
             self._state = new_state
+            self.state_changed_at = time.time()
             if new_state in TERMINAL:
                 self.finished_at = time.time()
             listeners = list(self._listeners)

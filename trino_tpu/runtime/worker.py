@@ -47,6 +47,8 @@ from ..connectors.spi import CatalogManager
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
+from ..utils import metrics as _metrics
+from ..utils.tracing import Tracer, add_exporters_from_env
 from .failure import Backoff, FaultInjector
 from .spool import SPOOL_URL, SpooledExchange
 from .wire import page_to_wire_chunks, partition_page, wire_to_page
@@ -76,6 +78,10 @@ class _Task:
         self.complete = False  # all output chunks present
         self.canceled = False
         self.cond = threading.Condition()
+        # per-task stats shipped to the coordinator in /status (reference:
+        # TaskStats inside TaskInfo): operator rows/ms, wall, exchange bytes
+        self.stats: dict = {}
+        self.bytes_served = 0  # result-buffer bytes handed to consumers
 
     def finish(self, buffers: dict[int, list]) -> None:
         with self.cond:
@@ -121,6 +127,31 @@ class Worker:
         self._place_lock = threading.Lock()
         self.spilled_chunks = 0  # observability
         self._lock = threading.Lock()
+        # per-worker registry: two in-process workers must not alias counters
+        self.metrics = _metrics.MetricsRegistry()
+        self._m_tasks = self.metrics.counter(
+            "trino_tpu_worker_tasks_total", "Task lifecycle events", ("event",)
+        )
+        self._m_task_seconds = self.metrics.histogram(
+            "trino_tpu_worker_task_seconds", "Task wall time"
+        )
+        self._m_fetched_bytes = self.metrics.counter(
+            "trino_tpu_exchange_fetched_bytes_total",
+            "Exchange bytes fetched from upstream tasks",
+        )
+        self._m_served_bytes = self.metrics.counter(
+            "trino_tpu_exchange_served_bytes_total",
+            "Result-buffer bytes served to consumers",
+        )
+        self._m_acks = self.metrics.counter(
+            "trino_tpu_exchange_chunks_acked_total",
+            "Buffer chunks freed by consumer acknowledge",
+        )
+        self._m_buffered = self.metrics.gauge(
+            "trino_tpu_worker_buffered_bytes", "RAM-resident output bytes"
+        )
+        self.tracer = Tracer()
+        add_exporters_from_env(self.tracer)
         self._pool = ThreadPoolExecutor(max_workers=task_concurrency)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -200,92 +231,145 @@ class Worker:
         task = _Task(task_id, query_id=req.get("query_id"))
         with self._lock:
             self.tasks[task_id] = task
+        self._m_tasks.labels("accepted").inc()
         self._pool.submit(self._run_task, task, req)
         return task
 
     def _run_task(self, task: _Task, req: dict) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        # join the coordinator's trace: the task span (and any children)
+        # shares the query's trace_id (W3C traceparent, utils/tracing.py)
+        self.tracer.join(req.get("traceparent"))
         try:
-            # fault matrix (FailureInjector.java:33): ERROR/TIMEOUT raise
-            # here, SLOW delays and falls through to normal execution
-            self.fault_injector.task_fault(task.task_id)
-            fragment = plan_from_json(req["fragment"])
-            executor = LocalExecutor(self.catalogs, self.default_catalog)
-            executor.split = (req["part"], req["num_parts"])
-            if req.get("memory_budget_bytes"):
-                executor.memory_budget_bytes = int(req["memory_budget_bytes"])
-
-            remote_pages: dict[int, Page] = {}
-            for fid_str, src in req.get("sources", {}).items():
-                fid = int(fid_str)
-                kind = src["kind"]
-                my_part = req["part"]
-                blobs: list[bytes] = []
-                if not (kind == "single" and my_part != 0):
-                    buffer_id = my_part if kind == "repartition" else 0
-                    # gather/broadcast/single buffers are read by EVERY
-                    # consumer task — acknowledging would free chunks under
-                    # the other readers (the reference gives each consumer
-                    # its own ClientBuffer; we share and skip the ack).
-                    # Under retry_policy=TASK the coordinator also disables
-                    # acks (ack_sources=False): a re-scheduled consumer must
-                    # be able to re-read its sources from token 0.
-                    ack = kind == "repartition" and req.get("ack_sources", True)
-                    for (u, t) in src["tasks"]:
-                        if task.canceled:
-                            raise RuntimeError("task canceled")
-                        if u == SPOOL_URL:
-                            # producer is gone; its committed output lives in
-                            # the durable exchange (re-read, not recompute)
-                            spool = SpooledExchange(req["exchange_dir"])
-                            blobs.extend(spool.read_chunks(t, buffer_id))
-                        else:
-                            blobs.extend(_stream_fetch(u, t, buffer_id, ack=ack))
-                from ..data.types import parse_type
-
-                types = [parse_type(t) for t in src["types"]]
-                remote_pages[fid] = wire_to_page(blobs, types)
-
-            # dynamic filtering: fetched build-side key domains narrow the
-            # probe scans before upload (exec/dynfilter.py; reference:
-            # DynamicFilterService.java:103)
-            from ..exec.dynfilter import collect_dynamic_filters
-
-            executor.scan_filters = collect_dynamic_filters(fragment, remote_pages)
-
-            page = executor.execute(fragment, remote_pages)
-
-            out_kind = req["output_kind"]
-            out_parts = req["out_parts"]
-            if out_kind == "repartition":
-                from ..plan.serde import _decode
-
-                keys = [_decode(k) for k in req["output_keys"]]
-                chunk_lists = partition_page(page, keys, out_parts)
-                buffers = {p: chunks for p, chunks in enumerate(chunk_lists)}
-            else:  # gather / broadcast / single / result
-                buffers = {0: page_to_wire_chunks(page)}
-
-            exchange_dir = req.get("exchange_dir")
-            if exchange_dir:
-                # durable spooled exchange: commit to storage FIRST, then
-                # serve every chunk from the spool files — worker RAM holds
-                # no finished output (bounded memory + dead-producer re-read)
-                spool = SpooledExchange(exchange_dir)
-                spool.commit_task(task.task_id, buffers)
-                task.finish(
-                    {
-                        p: [
-                            spool.chunk_path(task.task_id, p, i)
-                            for i in range(len(chunks))
-                        ]
-                        for p, chunks in buffers.items()
-                    }
-                )
-            else:
-                self._finish_placed(task, buffers)
+            with self.tracer.span(
+                "task", task_id=task.task_id, query_id=task.query_id or "",
+                worker=self.url,
+            ):
+                self._run_task_inner(task, req, t0)
+            self._m_tasks.labels("finished").inc()
         except Exception as e:
             traceback.print_exc()
+            task.stats = {
+                "wall_ms": (_time.perf_counter() - t0) * 1e3,
+                "operators": {},
+            }
             task.fail(str(e))
+            self._m_tasks.labels("failed").inc()
+        finally:
+            self._m_task_seconds.observe(_time.perf_counter() - t0)
+
+    def _run_task_inner(self, task: _Task, req: dict, t0: float) -> None:
+        import time as _time
+
+        # fault matrix (FailureInjector.java:33): ERROR/TIMEOUT raise
+        # here, SLOW delays and falls through to normal execution
+        self.fault_injector.task_fault(task.task_id)
+        fragment = plan_from_json(req["fragment"])
+        executor = LocalExecutor(self.catalogs, self.default_catalog)
+        executor.split = (req["part"], req["num_parts"])
+        executor.collect_operator_stats = True
+        if req.get("memory_budget_bytes"):
+            executor.memory_budget_bytes = int(req["memory_budget_bytes"])
+
+        fetched_bytes = 0
+        fetched_rows = 0
+        remote_pages: dict[int, Page] = {}
+        for fid_str, src in req.get("sources", {}).items():
+            fid = int(fid_str)
+            kind = src["kind"]
+            my_part = req["part"]
+            blobs: list[bytes] = []
+            if not (kind == "single" and my_part != 0):
+                buffer_id = my_part if kind == "repartition" else 0
+                # gather/broadcast/single buffers are read by EVERY
+                # consumer task — acknowledging would free chunks under
+                # the other readers (the reference gives each consumer
+                # its own ClientBuffer; we share and skip the ack).
+                # Under retry_policy=TASK the coordinator also disables
+                # acks (ack_sources=False): a re-scheduled consumer must
+                # be able to re-read its sources from token 0.
+                ack = kind == "repartition" and req.get("ack_sources", True)
+                for (u, t) in src["tasks"]:
+                    if task.canceled:
+                        raise RuntimeError("task canceled")
+                    if u == SPOOL_URL:
+                        # producer is gone; its committed output lives in
+                        # the durable exchange (re-read, not recompute)
+                        spool = SpooledExchange(req["exchange_dir"])
+                        blobs.extend(spool.read_chunks(t, buffer_id))
+                    else:
+                        blobs.extend(_stream_fetch(u, t, buffer_id, ack=ack))
+            from ..data.types import parse_type
+
+            fetched_bytes += sum(len(b) for b in blobs)
+            types = [parse_type(t) for t in src["types"]]
+            remote_pages[fid] = wire_to_page(blobs, types)
+            fetched_rows += _page_rows(remote_pages[fid])
+        self._m_fetched_bytes.inc(fetched_bytes)
+
+        # dynamic filtering: fetched build-side key domains narrow the
+        # probe scans before upload (exec/dynfilter.py; reference:
+        # DynamicFilterService.java:103)
+        from ..exec.dynfilter import collect_dynamic_filters
+
+        executor.scan_filters = collect_dynamic_filters(fragment, remote_pages)
+
+        if req.get("analyze"):
+            # distributed EXPLAIN ANALYZE: the eager node-hook pass adds
+            # per-operator wall ms on top of the exact row counts
+            page, an_stats = executor.explain_analyze(fragment, remote_pages)
+            operators = executor.last_operator_stats
+            for nid, s in an_stats.items():
+                if "ms" in s:
+                    operators.setdefault(nid, {})["ms"] = round(s["ms"], 3)
+        else:
+            page = executor.execute(fragment, remote_pages)
+            operators = executor.last_operator_stats
+
+        out_kind = req["output_kind"]
+        out_parts = req["out_parts"]
+        if out_kind == "repartition":
+            from ..plan.serde import _decode
+
+            keys = [_decode(k) for k in req["output_keys"]]
+            chunk_lists = partition_page(page, keys, out_parts)
+            buffers = {p: chunks for p, chunks in enumerate(chunk_lists)}
+        else:  # gather / broadcast / single / result
+            buffers = {0: page_to_wire_chunks(page)}
+
+        # stats must be on the task BEFORE finish() notifies status waiters
+        task.stats = {
+            "wall_ms": round((_time.perf_counter() - t0) * 1e3, 3),
+            "operators": {str(k): v for k, v in operators.items()},
+            "rows_out": _page_rows(page),
+            "output_bytes": sum(
+                len(c) for chunks in buffers.values() for c in chunks
+            ),
+            "exchange_bytes_fetched": fetched_bytes,
+            "exchange_rows_fetched": fetched_rows,
+            "rows_pruned": executor.rows_pruned,
+        }
+
+        exchange_dir = req.get("exchange_dir")
+        if exchange_dir:
+            # durable spooled exchange: commit to storage FIRST, then
+            # serve every chunk from the spool files — worker RAM holds
+            # no finished output (bounded memory + dead-producer re-read)
+            spool = SpooledExchange(exchange_dir)
+            spool.commit_task(task.task_id, buffers)
+            task.finish(
+                {
+                    p: [
+                        spool.chunk_path(task.task_id, p, i)
+                        for i in range(len(chunks))
+                    ]
+                    for p, chunks in buffers.items()
+                }
+            )
+        else:
+            self._finish_placed(task, buffers)
 
     # -------------------------------------------------------- buffer access
     def get_chunk(self, task_id: str, buffer_id: int, token: int, wait: float):
@@ -312,6 +396,8 @@ class Worker:
                         except OSError:
                             return 410, b"spooled chunk removed", {}
                     last = task.complete and token == len(chunks) - 1
+                    task.bytes_served += len(blob)
+                    self._m_served_bytes.inc(len(blob))
                     return 200, blob, {"X-Complete": "1" if last else "0"}
                 if task.complete:
                     # past the end: buffer exhausted
@@ -338,6 +424,8 @@ class Worker:
                             os.unlink(entry)
                         except OSError:
                             pass
+                    if entry is not None:
+                        self._m_acks.inc()
                     chunks[i] = None
 
     def task_status(self, task_id: str, wait: float) -> dict:
@@ -348,7 +436,18 @@ class Worker:
         with task.cond:
             if task.state == "RUNNING" and wait > 0:
                 task.cond.wait(timeout=wait)
-            return {"state": task.state, "error": task.error}
+            st = {"state": task.state, "error": task.error}
+            if task.stats:
+                st["stats"] = dict(
+                    task.stats, exchange_bytes_served=task.bytes_served
+                )
+            return st
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition for this worker + the process-global
+        registry (spill, caches, SPMD exchange planning)."""
+        self._m_buffered.set(self.buffered_bytes())
+        return self.metrics.render(extra=_metrics.GLOBAL)
 
     def _is_local_spill(self, path: str) -> bool:
         return self._spill_dir is not None and path.startswith(self._spill_dir)
@@ -367,6 +466,14 @@ class Worker:
                             except OSError:
                                 pass
                 task.buffers = {}
+
+
+def _page_rows(page: Page) -> int:
+    import numpy as np
+
+    if page.live is None:
+        return page.capacity
+    return int(np.asarray(page.live).sum())
 
 
 def _stream_fetch(
@@ -462,6 +569,12 @@ def _make_handler(worker: Worker):
                 kv.split("=", 1) for kv in query.split("&") if "=" in kv
             )
             parts = path.strip("/").split("/")
+            if parts[:1] == ["metrics"]:
+                return self._send(
+                    200,
+                    worker.metrics_text().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             if parts[:2] == ["v1", "info"]:
                 import resource as _res
 

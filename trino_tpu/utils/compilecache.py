@@ -44,3 +44,23 @@ def enable_persistent_cache(repo_root: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception:
         pass  # older jax without the knobs
+
+
+def cache_stats(repo_root: str | None = None) -> dict:
+    """On-disk XLA cache footprint for /metrics (entries + bytes); scraped
+    lazily so the walk only happens when somebody actually looks."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    d = jax_cache_dir(repo_root)
+    entries = 0
+    size = 0
+    try:
+        for name in os.listdir(d):
+            p = os.path.join(d, name)
+            if os.path.isfile(p):
+                entries += 1
+                size += os.path.getsize(p)
+    except OSError:
+        pass
+    return {"dir": d, "entries": entries, "bytes": size}

@@ -1,0 +1,295 @@
+"""Zero-dependency metrics registry with Prometheus text exposition.
+
+Reference: the engine exports JMX MBeans scraped into dashboards
+(io.airlift.stats CounterStat/DistributionStat on QueryManager,
+SqlTaskManager, ExchangeClient, ...); the modern deployment path is the
+OpenMetrics exporter.  Here the same three instrument kinds — Counter,
+Gauge, Histogram — with label support, rendered in Prometheus text
+exposition format 0.0.4 at GET /metrics on both coordinator and worker
+(runtime/coordinator.py, runtime/worker.py).
+
+Two scopes:
+  - a per-component `MetricsRegistry` (each Coordinator/Worker owns one, so
+    two workers in one test process don't alias each other's counters)
+  - the process-global `GLOBAL` registry for cross-cutting engine internals
+    that have no component handle (spill executor, capacity cache, compile
+    cache, SPMD exchange planning).  /metrics handlers render their own
+    registry followed by GLOBAL.
+
+Everything is thread-safe: instruments are created under the registry lock
+and each instrument guards its label-children map with its own lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "GLOBAL",
+    "global_registry",
+]
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f.is_integer() else repr(f)
+
+
+def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label(str(v))}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        # label-value tuple -> child state; () is the unlabeled child
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, *values, **kw):
+        if kw:
+            values = tuple(kw.get(n, "") for n in self.labelnames)
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {values}"
+            )
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._new_child()
+            return child
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _samples(self) -> list[tuple[str, str, float]]:
+        """[(name_suffix, label_str, value)] — one per exposition line."""
+        raise NotImplementedError
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for suffix, labels, value in self._samples():
+            lines.append(f"{self.name}{suffix}{labels} {_fmt_value(value)}")
+        return "\n".join(lines)
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += n
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, n: float = 1.0) -> None:
+        self.labels().inc(n)
+
+    def value(self, *label_values) -> float:
+        return self.labels(*label_values).value
+
+    def _samples(self):
+        with self._lock:
+            items = list(self._children.items())
+        return [
+            ("", _label_str(self.labelnames, vals), child.value)
+            for vals, child in items
+        ]
+
+
+class _GaugeChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.labels().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self.labels().dec(n)
+
+    def value(self, *label_values) -> float:
+        return self.labels(*label_values).value
+
+    def _samples(self):
+        with self._lock:
+            items = list(self._children.items())
+        return [
+            ("", _label_str(self.labelnames, vals), child.value)
+            for vals, child in items
+        ]
+
+
+# default buckets sized for query/task latencies in seconds
+DEFAULT_BUCKETS = (
+    0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+)
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            # per-bucket counts; _samples cumulates at render time
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    self.counts[i] += 1
+                    break
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def _samples(self):
+        with self._lock:
+            items = list(self._children.items())
+        out = []
+        for vals, child in items:
+            cum = 0
+            for le, c in zip(child.buckets, child.counts):
+                cum += c
+                out.append((
+                    "_bucket",
+                    _label_str(
+                        self.labelnames + ("le",), tuple(vals) + (_fmt_value(le),)
+                    ),
+                    cum,
+                ))
+            out.append((
+                "_bucket",
+                _label_str(self.labelnames + ("le",), tuple(vals) + ("+Inf",)),
+                child.count,
+            ))
+            out.append(("_sum", _label_str(self.labelnames, vals), child.sum))
+            out.append(("_count", _label_str(self.labelnames, vals), child.count))
+        return out
+
+
+class MetricsRegistry:
+    """get-or-create instrument registry; render() emits exposition text."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw) -> _Instrument:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, help, labelnames, **kw)
+            elif not isinstance(inst, cls) or inst.labelnames != tuple(labelnames):
+                raise ValueError(f"metric {name} re-registered with a different shape")
+            return inst
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(
+        self, name: str, help: str = "", labelnames=(), buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def render(self, extra: Optional["MetricsRegistry"] = None) -> str:
+        with self._lock:
+            instruments = list(self._instruments.values())
+        parts = [inst.render() for inst in instruments]
+        if extra is not None:
+            with extra._lock:
+                names = {i.name for i in instruments}
+                parts.extend(
+                    inst.render()
+                    for inst in extra._instruments.values()
+                    if inst.name not in names
+                )
+        return "\n".join(parts) + ("\n" if parts else "")
+
+
+# process-global registry for engine internals with no component handle
+GLOBAL = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    return GLOBAL
