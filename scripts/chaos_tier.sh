@@ -4,9 +4,29 @@
 # sqlite oracle.  Deterministic: a failing schedule replays from its seed
 # (tests/test_chaos.py::SEED).
 #
+# Subcommands (lifecycle chaos, tests/test_lifecycle.py):
+#   drain   graceful drain mid-query — zero retries, zero quarantine
+#   kill9   hard kill mid-query — recovery only via TASK retry from spool
+# No subcommand runs the full seeded chaos schedule suite (-m chaos).
+#
 # Not part of the tier-1 gate (marked slow); run it before touching the
 # runtime/ or parallel/ layers.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
-    -p no:cacheprovider "$@"
+
+case "${1:-}" in
+  drain)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py -q \
+        -k "drain" -p no:cacheprovider "$@"
+    ;;
+  kill9)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py -q \
+        -k "kill9" -p no:cacheprovider "$@"
+    ;;
+  *)
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+        -p no:cacheprovider "$@"
+    ;;
+esac

@@ -14,21 +14,51 @@ __all__ = ["StatementClient", "QueryFailed"]
 
 
 class QueryFailed(Exception):
-    pass
+    # typed failure reason from the protocol (errorCode), when the server
+    # attached one — e.g. EXCEEDED_TIME_LIMIT from the deadline watchdog
+    error_code: Optional[str] = None
 
 
 class StatementClient:
     def __init__(
         self, server_url: str, poll_interval: float = 0.05,
-        spooled: bool = False,
+        spooled: bool = False, shed_retries: int = 0,
     ):
         """spooled=True advertises the SPOOLED result protocol (reference:
         client/spooling SegmentLoader): when the server has a spool
         configured, results come back as segment URIs fetched out-of-band
-        (and acknowledged, releasing server storage) instead of inline."""
+        (and acknowledged, releasing server storage) instead of inline.
+
+        shed_retries > 0 makes submission retry up to that many times when
+        the coordinator load-sheds with 429, sleeping the server-suggested
+        Retry-After between attempts (reference: the client honoring
+        TOO_MANY_REQUESTS backpressure instead of failing outright)."""
         self.server_url = server_url.rstrip("/")
         self.poll_interval = poll_interval
         self.spooled = spooled
+        self.shed_retries = shed_retries
+
+    def _post_statement(self, sql: str, headers: dict) -> dict:
+        """POST /v1/statement, honoring 429 + Retry-After backpressure."""
+        attempt = 0
+        while True:
+            req = urllib.request.Request(
+                f"{self.server_url}/v1/statement", data=sql.encode(),
+                headers=headers,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code != 429 or attempt >= self.shed_retries:
+                    raise
+                attempt += 1
+                try:
+                    delay = float(e.headers.get("Retry-After") or 1)
+                except ValueError:
+                    delay = 1.0
+                e.read()  # drain the shed response before re-posting
+                time.sleep(delay)
 
     def _fetch_segments(self, state: dict) -> list[list]:
         rows: list[list] = []
@@ -45,12 +75,7 @@ class StatementClient:
     def execute(self, sql: str, timeout: float = 600.0) -> tuple[list[str], list[list]]:
         """-> (column_names, rows)"""
         headers = {"X-Trino-Spooled": "1"} if self.spooled else {}
-        req = urllib.request.Request(
-            f"{self.server_url}/v1/statement", data=sql.encode(),
-            headers=headers,
-        )
-        with urllib.request.urlopen(req, timeout=30) as r:
-            state = json.loads(r.read())
+        state = self._post_statement(sql, headers)
         deadline = time.time() + timeout
         while True:
             if "segments" in state:
@@ -58,7 +83,11 @@ class StatementClient:
             if "data" in state:
                 return state.get("columns", []), state["data"]
             if state.get("stats", {}).get("state") == "FAILED":
-                raise QueryFailed(state.get("error", "query failed"))
+                exc = QueryFailed(state.get("error", "query failed"))
+                # typed reason (EXCEEDED_TIME_LIMIT, ...) for callers that
+                # branch on failure class
+                exc.error_code = state.get("errorCode")
+                raise exc
             next_uri = state.get("nextUri")
             if next_uri is None:
                 raise QueryFailed(f"no nextUri and no data: {state}")
@@ -70,11 +99,7 @@ class StatementClient:
 
     def submit(self, sql: str) -> str:
         """Fire-and-return: the query id (poll or cancel it later)."""
-        req = urllib.request.Request(
-            f"{self.server_url}/v1/statement", data=sql.encode()
-        )
-        with urllib.request.urlopen(req, timeout=30) as r:
-            return json.loads(r.read())["id"]
+        return self._post_statement(sql, {})["id"]
 
     def cancel(self, query_id: str) -> bool:
         """Reference: StatementClient close() -> DELETE nextUri."""

@@ -127,6 +127,19 @@ class Coordinator:
         self._m_query_seconds = self.metrics.histogram(
             "trino_tpu_query_seconds", "End-to-end query wall seconds"
         )
+        self._m_speculative = self.metrics.counter(
+            "trino_tpu_speculative_attempts_total",
+            "Straggler backup attempts by outcome (launched/won/lost)",
+            ("outcome",),
+        )
+        self._m_deadline = self.metrics.counter(
+            "trino_tpu_deadline_kills_total",
+            "Queries killed by the deadline watchdog", ("reason",),
+        )
+        self._m_shed = self.metrics.counter(
+            "trino_tpu_queries_shed_total",
+            "Statements answered 429 by dispatch-queue load shedding",
+        )
         # query lifecycle events (reference: EventListener SPI fired from
         # QueryMonitor on the coordinator, not the workers)
         self.events = EventListenerManager()
@@ -180,6 +193,15 @@ class Coordinator:
         # a re-announcing worker (restart) starts with a clean bill of health
         self.failure_detector.reset(url)
 
+    def deregister_worker(self, url: str) -> None:
+        """Goodbye-announce from a drained worker (reference: the discovery
+        server dropping a SHUTTING_DOWN node): forget it NOW, so post-drain
+        probe failures never feed the circuit breaker — a graceful exit
+        must produce zero QUARANTINED transitions."""
+        with self._lock:
+            self.workers.pop(url, None)
+        self.failure_detector.forget(url)
+
     def alive_workers(self) -> list[str]:
         with self._lock:
             return [w.url for w in self.workers.values() if w.alive]
@@ -205,6 +227,13 @@ class Coordinator:
                     with urllib.request.urlopen(f"{w.url}/v1/info", timeout=2) as r:
                         info = json.loads(r.read())
                     det.record_success(w.url, time.monotonic() - t0)
+                    # the worker announces its lifecycle state in /v1/info:
+                    # DRAINING overlays the breaker (not dispatchable, but
+                    # healthy and fetchable — nothing scheduled on it is
+                    # retried, and no quarantine transition ever fires)
+                    det.set_draining(
+                        w.url, info.get("state") in ("draining", "drained")
+                    )
                     w.failures = 0
                     w.last_seen = time.time()
                     for qid, b in (info.get("buffered_by_query") or {}).items():
@@ -214,6 +243,7 @@ class Coordinator:
                     det.record_failure(w.url)
                 w.alive = det.is_dispatchable(w.url)
             self._enforce_cluster_memory(cluster_by_query)
+            self._enforce_deadlines()
             self._expire_old_queries()
 
     def _enforce_cluster_memory(self, by_query: dict[str, int]) -> None:
@@ -242,6 +272,55 @@ class Coordinator:
             record["cancel"] = True
             self.memory_kills += 1
             return  # one victim per sweep; re-evaluate next heartbeat
+
+    def _enforce_deadlines(self) -> None:
+        """Deadline watchdog (reference: QueryTracker.enforceTimeLimits):
+        each heartbeat sweep kills queries past query_max_run_time_s with a
+        typed EXCEEDED_TIME_LIMIT reason, and queries stuck QUEUED in their
+        resource group past query_max_queued_time_s with
+        EXCEEDED_QUEUED_TIME_LIMIT — an overloaded group sheds its backlog
+        instead of wedging clients for the full poll ceiling."""
+        max_run = float(self.session.get("query_max_run_time_s") or 0)
+        max_queued = float(self.session.get("query_max_queued_time_s") or 0)
+        now = time.time()
+        with self._lock:
+            records = list(self.queries.values())
+        for record in records:
+            sm: QueryStateMachine = record["sm"]
+            if sm.done:
+                continue
+            age = now - sm.created_at
+            if sm.state == "QUEUED":
+                # cancel_queued is atomic with admission: True only while
+                # the query still sits in the group queue, so a concurrent
+                # start can never be killed as "queued too long"
+                if (
+                    max_queued
+                    and age > max_queued
+                    and self.resource_groups.cancel_queued(sm.query_id)
+                ):
+                    self._m_deadline.labels("queued_time").inc()
+                    sm.fail(
+                        f"Query exceeded maximum queued time of "
+                        f"{max_queued}s (queued {age:.1f}s) "
+                        f"[EXCEEDED_QUEUED_TIME_LIMIT]",
+                        code="EXCEEDED_QUEUED_TIME_LIMIT",
+                    )
+                    record["done"].set()
+                continue
+            if max_run and age > max_run:
+                self._m_deadline.labels("run_time").inc()
+                reason = (
+                    f"Query exceeded maximum run time of {max_run}s "
+                    f"(ran {age:.1f}s) [EXCEEDED_TIME_LIMIT]"
+                )
+                record["kill_reason"] = reason
+                record["cancel"] = True  # running stages abort mid-flight
+                # fail the state machine NOW — the client sees the typed
+                # reason immediately; the background run's own late failure
+                # is absorbed by the terminal state
+                sm.fail(reason, code="EXCEEDED_TIME_LIMIT")
+                record["done"].set()
 
     def _expire_old_queries(self) -> None:
         """Age-based expiry of finished queries (reference: QueryTracker.
@@ -624,6 +703,10 @@ class Coordinator:
                 # time each operator eagerly
                 "traceparent": record.get("traceparent"),
                 "analyze": bool(record.get("analyze")),
+                # worker-side no-progress watchdog arming (0 disables)
+                "no_progress_timeout_s": float(
+                    self.session.get("task_no_progress_timeout_s") or 0.0
+                ),
             }
             tag = f"{sm.query_id}_a{attempt}_f{f.id}"
             frag_meta[f.id] = (payload_base, tag)
@@ -976,11 +1059,35 @@ class Coordinator:
         sources payload, so a retry doesn't re-fetch from a dead URL.
         should_abort() is checked between poll rounds: a non-None message
         aborts the stage mid-flight (cluster memory kill, client cancel) —
-        without it a cancellation would only be seen at stage boundaries."""
+        without it a cancellation would only be seen at stage boundaries.
+
+        Straggler speculation (session speculation_enabled; reference: the
+        MapReduce backup-task idea, Dean & Ghemawat OSDI'04): once at least
+        half the stage's parts completed, a part still running past
+        speculation_quantile x the stage's median completed wall time gets
+        ONE backup attempt on another dispatchable worker.  The backup
+        reuses the SAME task id (consumers address whichever copy wins; the
+        spooled exchange's first-commit-wins rename arbitrates exactly-once
+        on disk) with a distinct `attempt` label for its staging dir.  The
+        first FINISHED attempt wins; the loser is aborted via DELETE."""
         workers = self.alive_workers()
+        if not workers:
+            raise RuntimeError("no alive workers")
         urls: list[Optional[tuple[str, str]]] = [None] * nparts
         attempts = [0] * nparts
-        pending: dict[int, tuple[str, str]] = {}
+        # live attempts per part — usually one; speculation adds a backup
+        pending: dict[int, list[tuple[str, str]]] = {}
+        started: dict[int, float] = {}
+        durations: list[float] = []  # completed-part wall seconds
+        speculated: set[int] = set()  # one backup per part, ever
+        backup_worker: dict[int, str] = {}  # part -> backup attempt's worker
+        spec_enabled = (
+            bool(self.session.get("speculation_enabled")) and nparts > 1
+        )
+        spec_quantile = float(self.session.get("speculation_quantile") or 2.0)
+        # shorter long-poll rounds when speculating: straggler detection
+        # latency is one poll round
+        poll_wait = 1.0 if spec_enabled else 5.0
 
         def try_post(p: int, w: str, task_id: str, payload=None) -> bool:
             if posted is not None:
@@ -997,74 +1104,145 @@ class Coordinator:
             w = workers[p % len(workers)]
             task_id = f"{tag}_p{p}_t0"
             try_post(p, w, task_id)
-            pending[p] = (w, task_id)
+            pending[p] = [(w, task_id)]
+            started[p] = time.monotonic()
         while pending:
             if should_abort is not None:
                 msg = should_abort()
                 if msg:
                     raise RuntimeError(msg)
-            done: list[int] = []
-            with ThreadPoolExecutor(max_workers=max(len(pending), 1)) as pool:
+            polls = [
+                (p, u, t) for p, atts in pending.items() for (u, t) in atts
+            ]
+            with ThreadPoolExecutor(max_workers=max(len(polls), 1)) as pool:
                 futs = {
-                    p: pool.submit(self._task_status, u, t, 5.0)
-                    for p, (u, t) in pending.items()
+                    key: pool.submit(self._task_status, key[1], key[2], poll_wait)
+                    for key in polls
                 }
-            for p, fut in futs.items():
-                state = fut.result()
-                if state == "FINISHED":
-                    urls[p] = pending[p]
-                    done.append(p)
-                elif state in ("FAILED", "UNKNOWN", "UNREACHABLE"):
-                    attempts[p] += 1
-                    if attempts[p] >= max_attempts:
-                        raise RuntimeError(
-                            f"task {pending[p][1]} failed {attempts[p]} times"
-                        )
-                    self._m_retries.inc()
-                    if on_retry is not None:
-                        on_retry()
-                    bad_url = pending[p][0]
-                    if state == "UNREACHABLE":
-                        # feed the circuit breaker so repeated unreachability
-                        # quarantines the worker out of the dispatch pool
-                        self.failure_detector.record_failure(bad_url)
-                    alive = [
-                        w
-                        for w in self.alive_workers()
-                        if w != bad_url and self.failure_detector.is_dispatchable(w)
-                    ]
-                    if not alive:
-                        alive = [w for w in self.alive_workers() if w != bad_url]
-                    if not alive:
-                        alive = self.alive_workers()
-                    if not alive:
-                        raise RuntimeError("no alive workers for re-schedule")
-                    if refresh_sources is not None:
-                        payload_base = dict(
-                            payload_base, sources=refresh_sources()
-                        )
-                    w = alive[(p + attempts[p]) % len(alive)]
-                    task_id = f"{tag}_p{p}_t{attempts[p]}"
-                    payload_p = payload_base
-                    if payload_base.get("memory_budget_bytes"):
-                        # the failure may have been a memory-budget refusal:
-                        # THIS part re-runs with a 4x-per-attempt estimate,
-                        # NOT identically (reference: ExponentialGrowth
-                        # PartitionMemoryEstimator).  Scoped per part — a
-                        # shared compounding budget would evaporate the
-                        # limit after unrelated worker-death retries
-                        payload_p = dict(
-                            payload_base,
-                            memory_budget_bytes=(
-                                payload_base["memory_budget_bytes"]
-                                * 4 ** attempts[p]
-                            ),
-                        )
-                    try_post(p, w, task_id, payload_p)
-                    pending[p] = (w, task_id)
-            for p in done:
-                del pending[p]
+            states = {key: fut.result() for key, fut in futs.items()}
+            for p in list(pending):
+                atts = pending[p]
+                finished = [
+                    a for a in atts if states.get((p,) + a) == "FINISHED"
+                ]
+                if finished:
+                    winner = finished[0]
+                    urls[p] = winner
+                    durations.append(time.monotonic() - started[p])
+                    for a in atts:  # abort the speculation loser
+                        if a != winner:
+                            self._delete_task_quiet(*a)
+                    bw = backup_worker.pop(p, None)
+                    if bw is not None:
+                        self._m_speculative.labels(
+                            "won" if winner[0] == bw else "lost"
+                        ).inc()
+                    del pending[p]
+                    continue
+                still = []
+                for a in atts:
+                    st = states.get((p,) + a)
+                    if st in ("FAILED", "UNKNOWN", "UNREACHABLE"):
+                        if st == "UNREACHABLE":
+                            # feed the circuit breaker so repeated
+                            # unreachability quarantines the worker out of
+                            # the dispatch pool
+                            self.failure_detector.record_failure(a[0])
+                    else:
+                        still.append(a)
+                if still:
+                    pending[p] = still
+                    if (
+                        spec_enabled
+                        and len(still) == 1
+                        and p not in speculated
+                        and len(durations) >= max(1, nparts // 2)
+                    ):
+                        median = sorted(durations)[len(durations) // 2]
+                        elapsed = time.monotonic() - started[p]
+                        if elapsed > max(0.25, spec_quantile * median):
+                            u0, tid = still[0]
+                            cands = [
+                                w
+                                for w in self.alive_workers()
+                                if w != u0
+                                and self.failure_detector.is_dispatchable(w)
+                            ]
+                            if cands:
+                                speculated.add(p)
+                                w = cands[(p + 1) % len(cands)]
+                                if try_post(
+                                    p, w, tid,
+                                    dict(
+                                        payload_base,
+                                        attempt=f"s{attempts[p] + 1}",
+                                    ),
+                                ):
+                                    self._m_speculative.labels("launched").inc()
+                                    backup_worker[p] = w
+                                    pending[p] = still + [(w, tid)]
+                    continue
+                # every live attempt of this part ended badly: task retry
+                attempts[p] += 1
+                backup_worker.pop(p, None)
+                if attempts[p] >= max_attempts:
+                    raise RuntimeError(
+                        f"task {atts[0][1]} failed {attempts[p]} times"
+                    )
+                self._m_retries.inc()
+                if on_retry is not None:
+                    on_retry()
+                bad_url = atts[-1][0]
+                alive = [
+                    w
+                    for w in self.alive_workers()
+                    if w != bad_url and self.failure_detector.is_dispatchable(w)
+                ]
+                if not alive:
+                    alive = [w for w in self.alive_workers() if w != bad_url]
+                if not alive:
+                    alive = self.alive_workers()
+                if not alive:
+                    raise RuntimeError("no alive workers for re-schedule")
+                if refresh_sources is not None:
+                    payload_base = dict(
+                        payload_base, sources=refresh_sources()
+                    )
+                w = alive[(p + attempts[p]) % len(alive)]
+                task_id = f"{tag}_p{p}_t{attempts[p]}"
+                payload_p = payload_base
+                if payload_base.get("memory_budget_bytes"):
+                    # the failure may have been a memory-budget refusal:
+                    # THIS part re-runs with a 4x-per-attempt estimate,
+                    # NOT identically (reference: ExponentialGrowth
+                    # PartitionMemoryEstimator).  Scoped per part — a
+                    # shared compounding budget would evaporate the
+                    # limit after unrelated worker-death retries
+                    payload_p = dict(
+                        payload_base,
+                        memory_budget_bytes=(
+                            payload_base["memory_budget_bytes"]
+                            * 4 ** attempts[p]
+                        ),
+                    )
+                try_post(p, w, task_id, payload_p)
+                pending[p] = [(w, task_id)]
+                started[p] = time.monotonic()
         return urls  # type: ignore[return-value]
+
+    def _delete_task_quiet(self, url: str, task_id: str) -> None:
+        """Abort one task attempt (speculation loser) — DELETE frees its
+        buffers and flips its canceled flag; best-effort."""
+        if url == SPOOL_URL:
+            return
+        try:
+            req = urllib.request.Request(
+                f"{url}/v1/task/{task_id}", method="DELETE"
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                r.read()
+        except Exception:
+            pass
 
     def _worker_alive(self, url: str, timeout: float = 3.0) -> bool:
         try:
@@ -1271,11 +1449,13 @@ def _make_handler(coord: Coordinator):
         def log_message(self, *args):
             pass
 
-        def _send_json(self, code: int, obj) -> None:
+        def _send_json(self, code: int, obj, headers=None) -> None:
             body = json.dumps(obj, default=_json_default).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -1284,6 +1464,31 @@ def _make_handler(coord: Coordinator):
             body = self.rfile.read(n)
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "statement"]:
+                # load shedding BEFORE resource-group admission (reference:
+                # DispatchManager's queue bound answering TOO_MANY_REQUESTS):
+                # a saturated coordinator degrades to client backpressure
+                # (429 + Retry-After) instead of an ever-growing queue of
+                # timeouts
+                limit = int(coord.session.get("dispatch_queue_limit") or 0)
+                if limit:
+                    with coord._lock:
+                        active = sum(
+                            1 for r in coord.queries.values()
+                            if not r["sm"].done
+                        )
+                    if active >= limit:
+                        coord._m_shed.inc()
+                        return self._send_json(
+                            429,
+                            {
+                                "error": (
+                                    f"coordinator dispatch queue full "
+                                    f"({active} active >= limit {limit}); "
+                                    f"retry later"
+                                )
+                            },
+                            headers={"Retry-After": "1"},
+                        )
                 sql = body.decode()
                 spooled = self.headers.get("X-Trino-Spooled") == "1"
                 qid = coord.submit_query(sql, spooled=spooled)
@@ -1293,7 +1498,11 @@ def _make_handler(coord: Coordinator):
                 )
             if parts[:2] == ["v1", "announce"]:
                 req = json.loads(body)
-                coord.register_worker(req["url"])
+                if req.get("event") == "goodbye":
+                    # drained worker deregistering (graceful exit)
+                    coord.deregister_worker(req["url"])
+                else:
+                    coord.register_worker(req["url"])
                 return self._send_json(200, {})
             return self._send_json(404, {"error": "not found"})
 
@@ -1435,7 +1644,14 @@ def _make_handler(coord: Coordinator):
                 if sm.state == "FAILED":
                     return self._send_json(
                         200,
-                        {"id": qid, "stats": {"state": "FAILED"}, "error": sm.error},
+                        {
+                            "id": qid,
+                            "stats": {"state": "FAILED"},
+                            "error": sm.error,
+                            # typed reason (EXCEEDED_TIME_LIMIT, ...) for
+                            # clients that branch on failure class
+                            "errorCode": sm.error_code,
+                        },
                     )
                 if record.get("segments") is not None:
                     return self._send_json(

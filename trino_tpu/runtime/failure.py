@@ -27,7 +27,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-__all__ = ["Backoff", "FailureDetector", "FaultInjector", "WorkerHealth"]
+__all__ = [
+    "Backoff", "FailureDetector", "FaultInjector", "WorkerHealth", "DRAINING",
+]
 
 
 class Backoff:
@@ -93,6 +95,13 @@ class Backoff:
 OK = "OK"
 SUSPECT = "SUSPECT"
 QUARANTINED = "QUARANTINED"
+# third dispatchability state (reference: GracefulShutdownHandler flipping
+# ServerInfo to SHUTTING_DOWN): the worker is HEALTHY — it answers
+# heartbeats and serves exchange fetches — but must receive no new task
+# dispatches while it finishes running tasks and empties its buffers.
+# Distinct from QUARANTINED: no failure is recorded, no retry storm, and
+# the half-open probe machinery never engages.
+DRAINING = "DRAINING"
 
 
 @dataclass
@@ -105,6 +114,9 @@ class WorkerHealth:
     consecutive_failures: int = 0
     last_probe_at: float = field(default=0.0)
     quarantined_at: Optional[float] = None
+    # worker announced DRAINING: overlays the breaker state (which keeps
+    # tracking health underneath) everywhere except QUARANTINED
+    draining: bool = False
 
 
 class FailureDetector:
@@ -157,15 +169,41 @@ class FailureDetector:
             h = self._workers[url] = WorkerHealth()
         return h
 
+    @staticmethod
+    def _effective(h: WorkerHealth) -> str:
+        """The dispatchability state the scheduler sees.  QUARANTINED wins
+        (a draining worker that stops answering is still a dead worker);
+        otherwise an announced drain overlays OK/SUSPECT."""
+        if h.state == QUARANTINED:
+            return h.state
+        return DRAINING if h.draining else h.state
+
     def reset(self, url: str) -> None:
         """Forget a worker's history (re-announce after restart)."""
         with self._lock:
             self._workers[url] = WorkerHealth()
 
+    def forget(self, url: str) -> None:
+        """Drop a worker entirely (graceful deregistration after drain):
+        unlike reset, the worker stops appearing in snapshots."""
+        with self._lock:
+            self._workers.pop(url, None)
+
+    def set_draining(self, url: str, draining: bool = True) -> None:
+        """Mark a worker DRAINING (announced via its /v1/info state or a
+        shutdown PUT).  Not a failure: health tracking continues underneath
+        and no breaker transition to QUARANTINED is implied."""
+        with self._lock:
+            h = self._get(url)
+            old = self._effective(h)
+            h.draining = draining
+            new = self._effective(h)
+        self._notify(url, old, new)
+
     def record_success(self, url: str, latency: float = 0.0) -> None:
         with self._lock:
             h = self._get(url)
-            old = h.state
+            old = self._effective(h)
             h.consecutive_failures = 0
             h.error_ewma *= 1.0 - self.alpha
             h.latency_ewma = (
@@ -181,13 +219,13 @@ class FailureDetector:
                 h.quarantined_at = None
             elif h.state == SUSPECT and h.error_ewma < self.suspect_threshold:
                 h.state = OK
-            new = h.state
+            new = self._effective(h)
         self._notify(url, old, new)
 
     def record_failure(self, url: str) -> None:
         with self._lock:
             h = self._get(url)
-            old = h.state
+            old = self._effective(h)
             h.consecutive_failures += 1
             h.error_ewma = (1.0 - self.alpha) * h.error_ewma + self.alpha
             h.last_probe_at = self._clock()
@@ -202,18 +240,20 @@ class FailureDetector:
                 h.quarantined_at = self._clock()
             elif h.state == OK:
                 h.state = SUSPECT
-            new = h.state
+            new = self._effective(h)
         self._notify(url, old, new)
 
     def state(self, url: str) -> str:
         with self._lock:
-            return self._get(url).state
+            return self._effective(self._get(url))
 
     def is_dispatchable(self, url: str) -> bool:
         """May this worker receive NEW task dispatches?  SUSPECT still may
-        (degraded but serving); QUARANTINED may not until a probe succeeds."""
+        (degraded but serving); QUARANTINED may not until a probe succeeds;
+        DRAINING may not at all — but unlike QUARANTINED it stays healthy
+        and fetchable, so nothing already scheduled on it is retried."""
         with self._lock:
-            return self._get(url).state != QUARANTINED
+            return self._effective(self._get(url)) not in (QUARANTINED, DRAINING)
 
     def should_probe(self, url: str) -> bool:
         """Should the heartbeat loop contact this worker this sweep?
@@ -230,7 +270,7 @@ class FailureDetector:
         with self._lock:
             return {
                 url: {
-                    "state": h.state,
+                    "state": self._effective(h),
                     "error_ewma": round(h.error_ewma, 4),
                     "latency_ewma": round(h.latency_ewma, 6),
                     "consecutive_failures": h.consecutive_failures,

@@ -45,8 +45,52 @@ PROPERTIES: dict[str, _Prop] = {
         ),
         _Prop(
             "query_max_run_time_s", float, 3600.0,
-            "wall-clock limit enforced by the query state machine",
+            "wall-clock limit from query creation; the coordinator's "
+            "deadline watchdog kills the query with a typed "
+            "EXCEEDED_TIME_LIMIT reason once exceeded (reference: "
+            "QueryTracker.enforceTimeLimits + query_max_run_time)",
             lambda v: v > 0,
+        ),
+        _Prop(
+            "query_max_queued_time_s", float, 600.0,
+            "max time a query may sit QUEUED in its resource group before "
+            "the deadline watchdog kills it with a typed "
+            "EXCEEDED_QUEUED_TIME_LIMIT reason (reference: "
+            "query_max_queued_time); load sheds before it cascades",
+            lambda v: v > 0,
+        ),
+        _Prop(
+            "task_no_progress_timeout_s", float, 300.0,
+            "worker-side no-progress watchdog: a RUNNING task whose "
+            "progress beats (source fetch, execution milestones) freeze "
+            "for this long is failed — and, under retry_policy=TASK, "
+            "re-scheduled — instead of wedging its consumer for the full "
+            "status-poll ceiling; 0 disables",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "speculation_enabled", bool, False,
+            "straggler speculation under retry_policy=TASK: tasks running "
+            "past speculation_quantile x the stage's median completed "
+            "wall time get a backup attempt on another worker; first "
+            "FINISHED attempt wins, the loser is aborted (reference: the "
+            "MapReduce backup-task idea, Dean & Ghemawat OSDI'04)",
+            None,
+        ),
+        _Prop(
+            "speculation_quantile", float, 2.0,
+            "straggler threshold: elapsed > quantile x stage-median wall "
+            "of completed sibling tasks triggers a backup attempt",
+            lambda v: v >= 1.0,
+        ),
+        _Prop(
+            "dispatch_queue_limit", int, 0,
+            "coordinator load shedding: POST /v1/statement answers 429 + "
+            "Retry-After when this many queries are already queued or "
+            "running (checked BEFORE resource-group admission, so "
+            "overload degrades to backpressure instead of timeouts); "
+            "0 = unbounded",
+            lambda v: v >= 0,
         ),
         _Prop(
             "retry_policy", str, "NONE",

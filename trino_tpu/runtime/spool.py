@@ -15,12 +15,16 @@ Reference mechanisms this replaces, TPU-runtime-shaped:
   native/pageserde.cpp, so spooling is a plain byte write) and are served
   back by file read on fetch.
 
-Commit protocol: chunks are written under
-    {dir}/{task_id}/buf{buffer}/{token:06d}.bin
-then an empty `COMMITTED` marker lands last.  Readers treat a task dir
-without the marker as absent — a crashed producer can never expose a
+Commit protocol: chunks are staged under
+    {dir}/{task_id}.tmp-{attempt}/buf{buffer}/{token:06d}.bin
+with an empty `COMMITTED` marker written last inside the staging dir, then
+the whole dir is `os.rename`d to {dir}/{task_id}.  Readers treat a task
+dir without the marker as absent — a crashed producer can never expose a
 partial buffer (the reference's sink commit handshake,
-FileSystemExchangeSink.finish).
+FileSystemExchangeSink.finish) — and the rename makes commit FIRST-
+ATTEMPT-WINS: a second attempt of the same task id (task retry, straggler
+speculation) finds the target already present, removes its staging dir,
+and no-ops — it can never rewrite chunk files a consumer is mid-read on.
 """
 
 from __future__ import annotations
@@ -43,18 +47,37 @@ class SpooledExchange:
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------- producer
-    def commit_task(self, task_id: str, buffers: dict[int, list[bytes]]) -> None:
-        """Write every buffer's chunks, marker last (crash-atomic commit)."""
+    def commit_task(
+        self,
+        task_id: str,
+        buffers: dict[int, list[bytes]],
+        attempt: str = "0",
+    ) -> bool:
+        """Stage every buffer's chunks in a per-attempt tmp dir, then rename
+        into place — crash-atomic AND first-attempt-wins.  Returns True if
+        THIS attempt's output became the committed one, False if another
+        attempt already won (the staged bytes are discarded; the winner's
+        chunks, which consumers may be mid-read on, are never touched)."""
         tdir = os.path.join(self.dir, task_id)
-        os.makedirs(tdir, exist_ok=True)
+        if self.is_committed(task_id):
+            return False
+        tmp = os.path.join(self.dir, f"{task_id}.tmp-{attempt}")
+        shutil.rmtree(tmp, ignore_errors=True)  # stale crashed stage
         for buffer_id, chunks in buffers.items():
-            bdir = os.path.join(tdir, f"buf{buffer_id}")
+            bdir = os.path.join(tmp, f"buf{buffer_id}")
             os.makedirs(bdir, exist_ok=True)
             for token, blob in enumerate(chunks):
                 with open(os.path.join(bdir, f"{token:06d}.bin"), "wb") as f:
                     f.write(blob)
-        with open(os.path.join(tdir, _MARKER), "wb"):
+        os.makedirs(tmp, exist_ok=True)  # zero-buffer tasks still commit
+        with open(os.path.join(tmp, _MARKER), "wb"):
             pass
+        try:
+            os.rename(tmp, tdir)  # atomic publish; fails if the target exists
+            return True
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            return False
 
     # ------------------------------------------------------------- consumer
     def is_committed(self, task_id: str) -> bool:
@@ -81,13 +104,14 @@ class SpooledExchange:
 
     # -------------------------------------------------------------- cleanup
     def remove_query(self, query_prefix: str) -> None:
-        """Drop every committed task dir of one query (task ids are
-        `{query_id}_...`-prefixed) — the coordinator calls this when the
-        query reaches a terminal state."""
+        """Drop every committed task dir (and leftover staging dir) of one
+        query — the coordinator calls this when the query reaches a terminal
+        state.  Task ids are `{query_id}_...`-prefixed: matching on the
+        separator-qualified prefix keeps `q1` from also deleting `q10_*`."""
         try:
             names = os.listdir(self.dir)
         except FileNotFoundError:
             return
         for name in names:
-            if name.startswith(query_prefix):
+            if name.startswith(query_prefix + "_"):
                 shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)

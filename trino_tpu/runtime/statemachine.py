@@ -34,6 +34,10 @@ class QueryStateMachine:
         self._lock = threading.Lock()
         self._listeners: list[Callable[[str], None]] = []
         self.error: Optional[str] = None
+        # typed failure reason (reference: ErrorCode on QueryInfo — e.g.
+        # EXCEEDED_TIME_LIMIT, EXCEEDED_QUEUED_TIME_LIMIT, NO_PROGRESS);
+        # surfaced to the client alongside the message
+        self.error_code: Optional[str] = None
         self.created_at = time.time()
         self.finished_at: Optional[float] = None
         self.state_changed_at = self.created_at  # /ui "in state for" column
@@ -69,6 +73,8 @@ class QueryStateMachine:
             fn(new_state)
         return True
 
-    def fail(self, message: str) -> None:
+    def fail(self, message: str, code: Optional[str] = None) -> None:
         self.error = message
+        if code is not None:
+            self.error_code = code
         self.transition("FAILED")

@@ -19,7 +19,17 @@ Reference wiring this replaces (SURVEY §2.8, §3.2-3.3):
                               frees chunks below `token`
                               (HttpPageBufferClient.java:406-424)
   DELETE /v1/task/{id}        abort + free buffers
-  GET  /v1/info               heartbeat (failuredetector/HeartbeatFailureDetector)
+  GET  /v1/info               heartbeat (failuredetector/HeartbeatFailureDetector);
+                              reports the worker lifecycle state
+                              (active | draining | drained)
+  PUT  /v1/info/state         graceful drain trigger — body "DRAINING" (or
+                              the reference's "SHUTTING_DOWN") flips the
+                              worker into DRAINING: new task POSTs get 503
+                              + Retry-After, running tasks finish and
+                              commit their output, exchange fetches keep
+                              serving until consumers are done, then the
+                              worker deregisters (server/GracefulShutdownHandler
+                              + NodeStateChangeHandler PUT /v1/info/state)
   POST /v1/inject_failure     test-only fault matrix (ERROR | TIMEOUT |
                               SLOW | EXCHANGE_DROP, counted/probabilistic;
                               execution/FailureInjector.java:33 — see
@@ -37,6 +47,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import traceback
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -53,7 +64,13 @@ from .failure import Backoff, FaultInjector
 from .spool import SPOOL_URL, SpooledExchange
 from .wire import page_to_wire_chunks, partition_page, wire_to_page
 
-__all__ = ["Worker"]
+__all__ = ["Worker", "DrainingError"]
+
+
+class DrainingError(RuntimeError):
+    """Task submission refused because the worker is draining/drained —
+    surfaced over HTTP as 503 + Retry-After (reference: a SHUTTING_DOWN
+    node answering TaskResource POSTs with SERVER_SHUTTING_DOWN)."""
 
 
 class _Task:
@@ -82,9 +99,23 @@ class _Task:
         # TaskStats inside TaskInfo): operator rows/ms, wall, exchange bytes
         self.stats: dict = {}
         self.bytes_served = 0  # result-buffer bytes handed to consumers
+        # no-progress watchdog (reference: the stats-freeze detection the
+        # coordinator's _wait_task ceiling papers over today): execution
+        # milestones beat `progress()`; the worker's monitor thread fails a
+        # RUNNING task whose beats freeze past no_progress_timeout_s.  Armed
+        # only once the task THREAD starts — a task queued behind a full
+        # executor pool is waiting, not wedged.
+        self.no_progress_timeout_s = 0.0
+        self.last_progress_at = time.monotonic()
+        self.watchdog_armed = False
+
+    def progress(self) -> None:
+        self.last_progress_at = time.monotonic()
 
     def finish(self, buffers: dict[int, list]) -> None:
         with self.cond:
+            if self.state != "RUNNING":
+                return  # watchdog/abort already terminated this attempt
             self.buffers = {k: list(v) for k, v in buffers.items()}
             self.complete = True
             self.state = "FINISHED"
@@ -92,6 +123,8 @@ class _Task:
 
     def fail(self, msg: str) -> None:
         with self.cond:
+            if self.state != "RUNNING":
+                return  # terminal states absorb (first outcome wins)
             self.state = "FAILED"
             self.error = msg
             self.cond.notify_all()
@@ -150,8 +183,26 @@ class Worker:
         self._m_buffered = self.metrics.gauge(
             "trino_tpu_worker_buffered_bytes", "RAM-resident output bytes"
         )
+        self._m_drains = self.metrics.counter(
+            "trino_tpu_worker_drains_total",
+            "Graceful drain transitions entered by this worker",
+        )
+        self._m_no_progress = self.metrics.counter(
+            "trino_tpu_worker_no_progress_kills_total",
+            "Tasks failed by the no-progress watchdog",
+        )
         self.tracer = Tracer()
         add_exporters_from_env(self.tracer)
+        # lifecycle state (reference: NodeState ACTIVE/SHUTTING_DOWN served
+        # by ServerInfoResource): active -> draining -> drained.  DRAINING
+        # rejects new task POSTs but keeps serving status + exchange fetches.
+        self.state = "active"
+        # set by the launcher/test runner at announce time so drain can
+        # POST a goodbye-announce (deregister) instead of silently vanishing
+        # and tripping the coordinator's circuit breaker
+        self.coordinator_url: Optional[str] = None
+        self._monitor_stop = threading.Event()
+        self._monitor = threading.Thread(target=self._watchdog_loop, daemon=True)
         self._pool = ThreadPoolExecutor(max_workers=task_concurrency)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -213,9 +264,27 @@ class Worker:
 
     def start(self) -> "Worker":
         self._thread.start()
+        self._monitor.start()
         return self
 
-    def stop(self) -> None:
+    # ------------------------------------------------------------ lifecycle
+    def stop(self, graceful_timeout_s: float = 2.0) -> None:
+        """Graceful-by-default shutdown: route through the drain path with a
+        short deadline so running tasks commit their buffered output before
+        exit (reference: GracefulShutdownHandler waiting out active tasks),
+        then hard-stop.  `graceful_timeout_s=0` skips straight to kill()."""
+        if graceful_timeout_s > 0:
+            self.drain(
+                task_deadline_s=graceful_timeout_s,
+                ack_deadline_s=0.0,
+                deregister=False,
+            )
+        self.kill()
+
+    def kill(self) -> None:
+        """Hard stop — the SIGKILL analogue the chaos tests use to exercise
+        recovery paths: no drain, in-flight work is abandoned."""
+        self._monitor_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()  # close the listening socket: connection
         # attempts fail fast instead of hanging in the kernel accept queue
@@ -225,11 +294,145 @@ class Worker:
 
             shutil.rmtree(self._spill_dir, ignore_errors=True)
 
+    def request_drain(self) -> None:
+        """Async drain trigger (PUT /v1/info/state, SIGTERM): flips the
+        state immediately so the next heartbeat/dispatch sees DRAINING, and
+        completes the drain on a background thread."""
+        with self._lock:
+            already = self.state != "active"
+            if not already:
+                self.state = "draining"
+        if already:
+            return
+        self._m_drains.inc()
+        threading.Thread(
+            target=self.drain, kwargs={"entered": True}, daemon=True
+        ).start()
+
+    def drain(
+        self,
+        task_deadline_s: float = 60.0,
+        ack_deadline_s: float = 30.0,
+        deregister: bool = True,
+        entered: bool = False,
+    ) -> bool:
+        """Graceful drain (reference: GracefulShutdownHandler): stop
+        accepting tasks, let running tasks finish + spool-commit, keep
+        serving exchange fetches until consumers are done with this
+        worker's buffers (acked everything, or the coordinator deleted the
+        tasks at query end), then deregister.  Returns True when the worker
+        fully quiesced within the deadlines."""
+        if not entered:
+            with self._lock:
+                first = self.state == "active"
+                if first:
+                    self.state = "draining"
+            if first:
+                self._m_drains.inc()
+        with self.tracer.span("drain", worker=self.url):
+            quiesced = self._await_no_running_tasks(task_deadline_s)
+            drained = self._await_buffers_drained(ack_deadline_s)
+        with self._lock:
+            self.state = "drained"
+        if deregister:
+            self._deregister()
+        return quiesced and drained
+
+    def _await_no_running_tasks(self, deadline_s: float) -> bool:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            with self._lock:
+                running = [
+                    t for t in self.tasks.values() if t.state == "RUNNING"
+                ]
+            if not running:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def _await_buffers_drained(self, deadline_s: float) -> bool:
+        """Wait until no consumer still needs this worker: every buffer
+        chunk acked (entry None), or every task deleted (the coordinator
+        DELETEs all tasks at query end — phased/FTE consumers never ack, so
+        deletion is their 'done' signal)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            with self._lock:
+                tasks = list(self.tasks.values())
+            pending = False
+            for t in tasks:
+                with t.cond:
+                    if t.state == "RUNNING":
+                        pending = True
+                    for chunks in t.buffers.values():
+                        if any(c is not None for c in chunks):
+                            pending = True
+            if not pending:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def _deregister(self) -> None:
+        """Goodbye-announce (reference: the discovery server aging out a
+        SHUTTING_DOWN node): tells the coordinator to forget this worker
+        NOW, so post-drain heartbeat probes don't read as failures and trip
+        the circuit breaker into QUARANTINED."""
+        if not self.coordinator_url:
+            return
+        try:
+            req = urllib.request.Request(
+                f"{self.coordinator_url}/v1/announce",
+                data=json.dumps(
+                    {"url": self.url, "event": "goodbye"}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                r.read()
+        except Exception:
+            pass  # best-effort; the breaker's DRAINING overlay still holds
+
+    def _watchdog_loop(self) -> None:
+        """No-progress watchdog: fail RUNNING tasks whose progress beats
+        froze past their payload timeout while status still says RUNNING —
+        today a wedged task blocks its consumer for the full status-poll
+        ceiling (reference: stuck-task detection the coordinator's
+        QueryTracker does on frozen TaskStats)."""
+        while not self._monitor_stop.wait(0.25):
+            now = time.monotonic()
+            with self._lock:
+                tasks = list(self.tasks.values())
+            for t in tasks:
+                if (
+                    t.watchdog_armed
+                    and t.no_progress_timeout_s > 0
+                    and t.state == "RUNNING"
+                    and now - t.last_progress_at > t.no_progress_timeout_s
+                ):
+                    self._m_no_progress.inc()
+                    self._m_tasks.labels("no_progress_killed").inc()
+                    t.fail(
+                        f"task {t.task_id} made no progress for "
+                        f"{now - t.last_progress_at:.1f}s "
+                        f"(no_progress_timeout_s="
+                        f"{t.no_progress_timeout_s}) [NO_PROGRESS]"
+                    )
+
     # ------------------------------------------------------- task execution
     def submit_task(self, req: dict) -> _Task:
         task_id = req["task_id"]
-        task = _Task(task_id, query_id=req.get("query_id"))
         with self._lock:
+            if self.state != "active":
+                self._m_tasks.labels("rejected_draining").inc()
+                raise DrainingError(
+                    f"worker {self.url} is {self.state}; not accepting tasks"
+                )
+            task = _Task(task_id, query_id=req.get("query_id"))
+            task.no_progress_timeout_s = float(
+                req.get("no_progress_timeout_s") or 0.0
+            )
             self.tasks[task_id] = task
         self._m_tasks.labels("accepted").inc()
         self._pool.submit(self._run_task, task, req)
@@ -239,6 +442,10 @@ class Worker:
         import time as _time
 
         t0 = _time.perf_counter()
+        # arm the no-progress watchdog only now that the thread is live — a
+        # task queued behind a saturated pool is waiting, not wedged
+        task.progress()
+        task.watchdog_armed = True
         # join the coordinator's trace: the task span (and any children)
         # shares the query's trace_id (W3C traceparent, utils/tracing.py)
         self.tracer.join(req.get("traceparent"))
@@ -248,13 +455,18 @@ class Worker:
                 worker=self.url,
             ):
                 self._run_task_inner(task, req, t0)
-            self._m_tasks.labels("finished").inc()
+            # the watchdog may have failed this task while it was wedged;
+            # a late successful run must not count (or report) as finished
+            if task.state == "FINISHED":
+                self._m_tasks.labels("finished").inc()
         except Exception as e:
-            traceback.print_exc()
-            task.stats = {
-                "wall_ms": (_time.perf_counter() - t0) * 1e3,
-                "operators": {},
-            }
+            if not task.canceled:  # canceled attempts fail by design
+                traceback.print_exc()
+            if task.state == "RUNNING":
+                task.stats = {
+                    "wall_ms": (_time.perf_counter() - t0) * 1e3,
+                    "operators": {},
+                }
             task.fail(str(e))
             self._m_tasks.labels("failed").inc()
         finally:
@@ -264,8 +476,12 @@ class Worker:
         import time as _time
 
         # fault matrix (FailureInjector.java:33): ERROR/TIMEOUT raise
-        # here, SLOW delays and falls through to normal execution
+        # here, SLOW delays and falls through to normal execution.  A SLOW
+        # wedge sits between two progress beats, so the no-progress
+        # watchdog sees frozen stats — exactly the wedged-task shape it
+        # exists to catch.
         self.fault_injector.task_fault(task.task_id)
+        task.progress()
         fragment = plan_from_json(req["fragment"])
         executor = LocalExecutor(self.catalogs, self.default_catalog)
         executor.split = (req["part"], req["num_parts"])
@@ -307,6 +523,7 @@ class Worker:
             types = [parse_type(t) for t in src["types"]]
             remote_pages[fid] = wire_to_page(blobs, types)
             fetched_rows += _page_rows(remote_pages[fid])
+            task.progress()  # each fetched source is a watchdog beat
         self._m_fetched_bytes.inc(fetched_bytes)
 
         # dynamic filtering: fetched build-side key domains narrow the
@@ -327,6 +544,7 @@ class Worker:
         else:
             page = executor.execute(fragment, remote_pages)
             operators = executor.last_operator_stats
+        task.progress()  # execution done — beat before output partitioning
 
         out_kind = req["output_kind"]
         out_parts = req["out_parts"]
@@ -352,13 +570,24 @@ class Worker:
             "rows_pruned": executor.rows_pruned,
         }
 
+        if task.canceled:
+            # aborted mid-run (speculation loser, query cleanup): a late
+            # commit after remove_query would leak task dirs in the spool
+            raise RuntimeError("task canceled")
         exchange_dir = req.get("exchange_dir")
         if exchange_dir:
             # durable spooled exchange: commit to storage FIRST, then
             # serve every chunk from the spool files — worker RAM holds
             # no finished output (bounded memory + dead-producer re-read)
             spool = SpooledExchange(exchange_dir)
-            spool.commit_task(task.task_id, buffers)
+            # per-attempt staging dir (speculation runs two live attempts
+            # of the same task id); the spool's rename publish arbitrates
+            # first-commit-wins — the loser's bytes are discarded and
+            # consumers address one canonical committed dir either way
+            spool.commit_task(
+                task.task_id, buffers, attempt=str(req.get("attempt") or 0)
+            )
+            task.progress()
             task.finish(
                 {
                     p: [
@@ -581,7 +810,7 @@ def _make_handler(worker: Worker):
                 by_query = worker.buffered_by_query()
                 body = json.dumps(
                     {
-                        "state": "active",
+                        "state": worker.state,
                         "tasks": len(worker.tasks),
                         # cluster memory visibility (reference: MemoryInfo
                         # polled by ClusterMemoryManager.java:92); ru_maxrss
@@ -621,7 +850,14 @@ def _make_handler(worker: Worker):
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "task"]:
                 req = json.loads(body)
-                worker.submit_task(req)
+                try:
+                    worker.submit_task(req)
+                except DrainingError as e:
+                    # reference: SERVER_SHUTTING_DOWN — the dispatcher must
+                    # pick another node, not retry this one in a tight loop
+                    return self._send(
+                        503, str(e).encode(), headers={"Retry-After": "1"}
+                    )
                 return self._send(200, b'{"state": "RUNNING"}', "application/json")
             if parts[:2] == ["v1", "inject_failure"]:
                 req = json.loads(body)
@@ -637,6 +873,28 @@ def _make_handler(worker: Worker):
                 except ValueError as e:
                     return self._send(400, str(e).encode())
                 return self._send(200, b"{}", "application/json")
+            return self._send(404, b"not found")
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            parts = self.path.strip("/").split("/")
+            # PUT /v1/info/state "DRAINING" — graceful drain trigger
+            # (reference: NodeStateChangeHandler; curl-able ops surface)
+            if parts == ["v1", "info", "state"]:
+                try:
+                    want = json.loads(body)
+                except (ValueError, UnicodeDecodeError):
+                    want = body.decode(errors="replace")
+                want = str(want).strip().strip('"').upper()
+                if want in ("DRAINING", "SHUTTING_DOWN"):
+                    worker.request_drain()
+                    return self._send(
+                        200,
+                        json.dumps({"state": worker.state}).encode(),
+                        "application/json",
+                    )
+                return self._send(400, f"unsupported state {want!r}".encode())
             return self._send(404, b"not found")
 
         def do_DELETE(self):

@@ -60,15 +60,30 @@ def main(argv=None) -> int:
     ).start()
     print(f"worker listening on {worker.url}", flush=True)
     if cfg.discovery_uri:
+        worker.coordinator_url = cfg.discovery_uri  # drain deregisters here
         req = urllib.request.Request(
             f"{cfg.discovery_uri}/v1/announce",
             data=json.dumps({"url": worker.url}).encode(),
         )
         urllib.request.urlopen(req, timeout=10).read()
         print(f"announced to {cfg.discovery_uri}", flush=True)
+
+    # SIGTERM == graceful drain (reference: GracefulShutdownHandler bound
+    # to the shutdown hook): finish running tasks, commit output, serve
+    # remaining fetches, deregister — then exit.  kill -9 stays the hard
+    # death the chaos harness exercises.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        print("SIGTERM: draining", flush=True)
+        worker.request_drain()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
-        while True:
-            time.sleep(3600)
+        while worker.state != "drained":
+            time.sleep(0.2)
+        print("drained; exiting", flush=True)
+        worker.kill()
     except KeyboardInterrupt:
         worker.stop()
     return 0

@@ -96,6 +96,35 @@ class ChaosRunner:
         finally:
             self.clear_faults()
 
+    def run_query_with_action(
+        self, sql: str, action, delay_s: float = 0.1
+    ) -> list[tuple]:
+        """Lifecycle chaos: run `sql` with `action()` fired from a
+        background thread after delay_s — drain or hard-kill a worker
+        mid-flight (runner.drain_worker / runner.kill_worker).  The query
+        is expected to survive; action exceptions surface after the rows."""
+        import threading
+        import time as _time
+
+        err: list[BaseException] = []
+
+        def _fire():
+            _time.sleep(delay_s)
+            try:
+                action()
+            except BaseException as e:  # surfaced below, not swallowed
+                err.append(e)
+
+        t = threading.Thread(target=_fire, daemon=True)
+        t.start()
+        try:
+            rows = self.runner.query(sql)
+        finally:
+            t.join()
+        if err:
+            raise err[0]
+        return rows
+
     # ------------------------------------------------------------ observability
 
     def fired(self) -> list[tuple[str, str]]:

@@ -56,6 +56,9 @@ class DistributedQueryRunner:
                 buffer_memory_bytes=self.worker_buffer_memory_bytes,
             ).start()
             self.workers.append(w)
+            # the worker knows its coordinator so a completed drain can
+            # deregister itself (goodbye announce)
+            w.coordinator_url = self.coordinator.url
             # announce over the wire like a real worker would
             req = urllib.request.Request(
                 f"{self.coordinator.url}/v1/announce",
@@ -69,6 +72,22 @@ class DistributedQueryRunner:
             w.stop()
         if self.coordinator is not None:
             self.coordinator.stop()
+
+    def drain_worker(self, index: int) -> None:
+        """Trigger a graceful drain over the wire (PUT /v1/info/state
+        DRAINING) — the worker finishes running tasks, keeps serving its
+        buffers, then deregisters.  Returns immediately; the drain
+        completes on the worker's background thread."""
+        w = self.workers[index]
+        req = urllib.request.Request(
+            f"{w.url}/v1/info/state", data=b'"DRAINING"', method="PUT"
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def kill_worker(self, index: int) -> None:
+        """Hard-stop a worker (the SIGKILL analogue): no drain, in-flight
+        tasks are abandoned — recovery must come from retry/spool."""
+        self.workers[index].kill()
 
     def query(self, sql: str) -> list[tuple]:
         """Direct (synchronous) execution through the scheduler."""
