@@ -7,6 +7,10 @@
 # Subcommands (lifecycle chaos, tests/test_lifecycle.py):
 #   drain   graceful drain mid-query — zero retries, zero quarantine
 #   kill9   hard kill mid-query — recovery only via TASK retry from spool
+# Memory-governance chaos (tests/test_memory_governance.py):
+#   corrupt page-frame corruption mid-fetch — crc32 detect + token re-fetch
+#   oom     MEMORY_PRESSURE pool shrink / blocked-on-memory / low-memory
+#           killer / revocation-driven spill scenarios
 # No subcommand runs the full seeded chaos schedule suite (-m chaos).
 #
 # Not part of the tier-1 gate (marked slow); run it before touching the
@@ -24,6 +28,17 @@ case "${1:-}" in
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py -q \
         -k "kill9" -p no:cacheprovider "$@"
+    ;;
+  corrupt)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_memory_governance.py -q \
+        -k "corrupt" -p no:cacheprovider "$@"
+    ;;
+  oom)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_memory_governance.py -q \
+        -k "memory_pressure or killer or blocked or revocation" \
+        -p no:cacheprovider "$@"
     ;;
   *)
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \

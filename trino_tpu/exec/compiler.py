@@ -127,6 +127,9 @@ class LocalExecutor:
         # up-front estimate over planned capacities, the TPU analogue of
         # reserving from a memory pool before running
         self.memory_budget_bytes: Optional[int] = None
+        # last up-front estimate computed at the budget check — surfaced by
+        # the worker next to its NodeMemoryPool reservation (memory plane)
+        self.last_estimated_bytes = 0
         # caps that completed a query without overflow, keyed by plan: repeat
         # executions skip the growth retries (the reference's runtime-adaptive
         # statistics feedback, AdaptivePlanner, in miniature)
@@ -313,6 +316,10 @@ class LocalExecutor:
         budget = self.memory_budget_bytes
         if budget:
             est = self._estimate_bytes(inputs, caps)
+            # recorded for the memory-governance plane: the worker reports
+            # this alongside its NodeMemoryPool reservation so the cluster
+            # memory manager sees estimated vs reserved bytes per task
+            self.last_estimated_bytes = est
             if est > budget:
                 raise MemoryBudgetExceeded(
                     f"task needs ~{est} bytes of device memory,"

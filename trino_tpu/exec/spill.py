@@ -21,6 +21,15 @@ each stage's working set, so any state that fits on disk completes:
 Partition count P is chosen from the memory estimate vs the query budget
 (runtime/memory.py) — the analogue of the reference's
 ExponentialGrowthPartitionMemoryEstimator picking bigger nodes on retry.
+
+The cluster memory manager's REVOCATION path reuses the same trick at the
+worker: when the coordinator revokes a query's revocable lease on a
+pressured node (runtime/memory.py NodeMemoryPool.revoke_query), the task
+re-slices its scan split into REVOKE_SPILL_PARTS sub-slices and runs them
+sequentially (runtime/worker.py _execute_sliced) — time-multiplexing the
+working set exactly like this executor does, shrinking peak memory to
+~1/P without killing the query (reference: MemoryRevokingScheduler
+triggering spill in HashBuilderOperator / SpillableHashAggregationBuilder).
 """
 
 from __future__ import annotations

@@ -223,6 +223,12 @@ class PageSerde:
     def deserialize_columns(self, data: bytes) -> dict[str, np.ndarray]:
         import json
 
+        if data[:4] == b"TPG1":
+            # integrity-framed wire chunk (runtime/wire.py frame_chunk):
+            # verify + strip so direct consumers of exchange blobs work
+            from ..runtime.wire import unframe_chunk
+
+            data = unframe_chunk(data)
         buffers, nrows = self.deserialize(data)
         schema = json.loads(buffers[0].decode("utf-8"))
         out: dict[str, np.ndarray] = {}

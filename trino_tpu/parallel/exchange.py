@@ -16,6 +16,14 @@ step, executing over ICI with no host involvement:
 Bucket capacity B is static; the kernel reports the true max bucket fill
 (pmax across devices) so the host can retry a bigger tier — backpressure by
 recompilation instead of the reference's blocking isBlocked() futures.
+
+Page integrity boundary: exchanges that leave the slice as HOST BYTES
+(HTTP fetches, spool files) carry a crc32 frame verified on every read
+(runtime/wire.py frame_chunk/unframe_chunk — the reference's PagesSerde
+checksums).  THIS path intentionally carries none: ICI collectives never
+materialize host bytes (link-layer CRC + ECC cover the transfer), so the
+frame is applied exactly where data first becomes bytes — page_to_wire* on
+the producing worker — and checked wherever bytes are consumed.
 """
 
 from __future__ import annotations

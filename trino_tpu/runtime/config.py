@@ -113,6 +113,9 @@ class NodeConfig:
         self.cluster_memory_limit_bytes = int(
             props.get("memory.heap-headroom-per-node", "0")
         )
+        # the same headroom figure sizes each worker's NodeMemoryPool
+        # (runtime/memory.py) — task reservations are carved from it
+        self.node_memory_bytes = self.cluster_memory_limit_bytes
         self.exchange_spool_dir = props.get("exchange.spool-dir", "")
         self.retry_policy = props.get("retry-policy", "NONE")
         self.task_concurrency = int(props.get("task.concurrency", "4"))

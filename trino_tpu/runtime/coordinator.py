@@ -42,6 +42,7 @@ from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
 from .failure import Backoff, FailureDetector
+from .memory import ClusterMemoryManager
 from .session import SessionProperties
 from .spool import SPOOL_URL, SpooledExchange
 from .statemachine import QueryStateMachine
@@ -70,6 +71,9 @@ class _WorkerInfo:
         self.alive = True
         self.last_seen = time.time()
         self.failures = 0
+        # last node-memory-pool snapshot from /v1/info (None = worker runs
+        # without a governed pool); feeds the cluster memory manager + /ui
+        self.mem: Optional[dict] = None
 
 
 class Coordinator:
@@ -96,6 +100,12 @@ class Coordinator:
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.memory_kills = 0  # observability
         self.memory_requeues = 0  # memory kills degraded to out-of-core
+        # node-pool arbitration over worker heartbeat snapshots (reference:
+        # ClusterMemoryManager.java:92 + TotalReservationLowMemoryKiller):
+        # sustained node pressure first revokes the largest revocable
+        # holder (forced spill), then kills the largest total reservation
+        self.cluster_memory_manager = ClusterMemoryManager()
+        self.oom_kills = 0  # queries killed with CLUSTER_OUT_OF_MEMORY
         self._lock = threading.Lock()
         self.heartbeat_interval = heartbeat_interval
         # coordinator control-plane metrics, exposed at GET /metrics in
@@ -139,6 +149,14 @@ class Coordinator:
         self._m_shed = self.metrics.counter(
             "trino_tpu_queries_shed_total",
             "Statements answered 429 by dispatch-queue load shedding",
+        )
+        self._m_oom_kills = self.metrics.counter(
+            "trino_tpu_oom_kills_total",
+            "Queries killed by the low-memory killer (CLUSTER_OUT_OF_MEMORY)",
+        )
+        self._m_revocations_requested = self.metrics.counter(
+            "trino_tpu_memory_revocations_requested_total",
+            "Revocation (forced-spill) requests sent to workers",
         )
         # query lifecycle events (reference: EventListener SPI fired from
         # QueryMonitor on the coordinator, not the workers)
@@ -218,6 +236,7 @@ class Coordinator:
             with self._lock:
                 infos = list(self.workers.values())
             cluster_by_query: dict[str, int] = {}
+            mem_snapshots: dict[str, dict] = {}
             for w in infos:
                 if not det.should_probe(w.url):
                     w.alive = False  # quarantined, half-open window closed
@@ -238,11 +257,15 @@ class Coordinator:
                     w.last_seen = time.time()
                     for qid, b in (info.get("buffered_by_query") or {}).items():
                         cluster_by_query[qid] = cluster_by_query.get(qid, 0) + int(b)
+                    w.mem = info.get("memory_pool")
+                    if w.mem:
+                        mem_snapshots[w.url] = w.mem
                 except Exception:
                     w.failures += 1
                     det.record_failure(w.url)
                 w.alive = det.is_dispatchable(w.url)
             self._enforce_cluster_memory(cluster_by_query)
+            self._enforce_node_memory(mem_snapshots)
             self._enforce_deadlines()
             self._expire_old_queries()
 
@@ -272,6 +295,79 @@ class Coordinator:
             record["cancel"] = True
             self.memory_kills += 1
             return  # one victim per sweep; re-evaluate next heartbeat
+
+    def _enforce_node_memory(self, snapshots: dict[str, dict]) -> None:
+        """Node-pool memory governance (reference: ClusterMemoryManager.
+        java:92 + LowMemoryKiller).  Workers attach their NodeMemoryPool
+        snapshot (reserved/blocked/per-query leases) to /v1/info; a node
+        whose pressure — reservations over capacity, or tasks parked
+        blocked-on-memory — persists past low_memory_killer_delay_s gets
+        ONE escalation per sweep: ask the largest revocable holder to
+        force-spill (the worker's sliced out-of-core execution honors the
+        shrunken lease), or, when nothing revocable remains (or revocation
+        is disabled), kill the query with the largest cluster-wide total
+        reservation with a typed CLUSTER_OUT_OF_MEMORY error."""
+        if not snapshots:
+            return
+        # only ACTIVE queries are revocation/kill candidates: a killed
+        # query's leases linger until its tasks are deleted — acting on
+        # those ghost bytes would cascade one pressure event into many
+        # victims
+        with self._lock:
+            active = {
+                qid for qid, rec in self.queries.items() if not rec["sm"].done
+            }
+        filtered = {
+            url: dict(
+                snap,
+                by_query={
+                    q: v
+                    for q, v in (snap.get("by_query") or {}).items()
+                    if q in active
+                },
+            )
+            for url, snap in snapshots.items()
+        }
+        actions = self.cluster_memory_manager.sweep(
+            filtered,
+            killer_delay_s=float(
+                self.session.get("low_memory_killer_delay_s") or 5.0
+            ),
+            revocation_enabled=bool(
+                self.session.get("memory_revocation_enabled")
+            ),
+        )
+        for act in actions:
+            if act["action"] == "revoke":
+                self._m_revocations_requested.inc()
+                try:
+                    req = urllib.request.Request(
+                        f"{act['node']}/v1/memory/revoke",
+                        data=json.dumps(
+                            {"query_id": act["query_id"]}
+                        ).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        r.read()
+                except Exception:
+                    pass  # worker gone: the breaker path handles it
+                continue
+            record = self.queries.get(act["query_id"])
+            if record is None or record["sm"].done:
+                continue
+            self._m_oom_kills.inc()
+            self.oom_kills += 1
+            reason = (
+                f"Query killed: a worker node memory pool stayed over "
+                f"budget past low_memory_killer_delay_s and nothing was "
+                f"revocable; this query held the largest total reservation "
+                f"({act['bytes']} bytes) [CLUSTER_OUT_OF_MEMORY]"
+            )
+            record["kill_reason"] = reason
+            record["cancel"] = True  # running stages abort mid-flight
+            record["sm"].fail(reason, code="CLUSTER_OUT_OF_MEMORY")
+            record["done"].set()
 
     def _enforce_deadlines(self) -> None:
         """Deadline watchdog (reference: QueryTracker.enforceTimeLimits):
@@ -707,6 +803,15 @@ class Coordinator:
                 "no_progress_timeout_s": float(
                     self.session.get("task_no_progress_timeout_s") or 0.0
                 ),
+                # node-pool reservation each task takes before executing
+                # (0 = ungoverned); a full pool parks the task BLOCKED
+                # until peers free bytes or the timeout escalates
+                "memory_reserve_bytes": int(
+                    self.session.get("task_memory_reserve_bytes") or 0
+                ),
+                "memory_blocked_timeout_s": float(
+                    self.session.get("memory_blocked_timeout_s") or 0.0
+                ),
             }
             tag = f"{sm.query_id}_a{attempt}_f{f.id}"
             frag_meta[f.id] = (payload_base, tag)
@@ -885,6 +990,8 @@ class Coordinator:
         stages = []
         cpu_ms = 0.0
         peak_mem = 0
+        mem_blocked_ms = 0.0
+        mem_revocations = 0
         for f in sorted(fragments, key=lambda fr: fr.id):
             ops: dict[int, dict] = {}
             task_infos = []
@@ -917,7 +1024,13 @@ class Coordinator:
                     }
                     task_infos.append(ti)
                     cpu_ms += float(st.get("wall_ms") or 0.0)
-                    peak_mem = max(peak_mem, int(st.get("output_bytes") or 0))
+                    peak_mem = max(
+                        peak_mem,
+                        int(st.get("output_bytes") or 0),
+                        int(st.get("memory_reserved_bytes") or 0),
+                    )
+                    mem_blocked_ms += float(st.get("memory_blocked_ms") or 0.0)
+                    mem_revocations += int(bool(st.get("memory_revoked")))
                     for nid_s, s in (st.get("operators") or {}).items():
                         nid = int(nid_s)
                         agg = ops.get(nid)
@@ -958,6 +1071,8 @@ class Coordinator:
             "stage_count": len(stages),
             "cpu_ms": round(cpu_ms, 3),
             "peak_memory_bytes": peak_mem,
+            "memory_blocked_ms": round(mem_blocked_ms, 3),
+            "memory_revocations": mem_revocations,
             "wall_ms": round((time.perf_counter() - t_query0) * 1e3, 3),
             "output_rows": len(record["result"] or []),
             "task_retries": record.get("task_retries", 0),
@@ -1546,10 +1661,28 @@ def _make_handler(coord: Coordinator):
                         f"<td><code>{_html.escape(str(rec.get('sql'))[:120])}</code></td></tr>"
                         for qid, rec in list(coord.queries.items())[-50:]
                     )
+                    def _mem_cells(w) -> str:
+                        # reserved/revocable bytes from the worker's last
+                        # node-pool heartbeat snapshot; "-" = ungoverned
+                        if not w.mem:
+                            return "<td>-</td><td>-</td><td>-</td>"
+                        revocable = sum(
+                            int(q.get("revocable") or 0)
+                            for q in (w.mem.get("by_query") or {}).values()
+                        )
+                        blocked = int(w.mem.get("blocked") or 0)
+                        return (
+                            f"<td>{int(w.mem.get('reserved') or 0)}"
+                            f"/{int(w.mem.get('capacity') or 0)}</td>"
+                            f"<td>{revocable}</td>"
+                            f"<td>{blocked}</td>"
+                        )
+
                     wrows = "".join(
                         f"<tr><td>{_html.escape(w.url)}</td>"
                         f"<td>{'alive' if w.alive else 'dead'}</td>"
-                        f"<td>{now - w.last_seen:.1f}</td></tr>"
+                        f"<td>{now - w.last_seen:.1f}</td>"
+                        f"{_mem_cells(w)}</tr>"
                         for w in list(coord.workers.values())
                     )
                     nworkers = len(coord.workers)
@@ -1563,6 +1696,8 @@ def _make_handler(coord: Coordinator):
                     "<h2>trino_tpu coordinator</h2>"
                     f"<h3>workers ({nworkers})</h3>"
                     "<table><tr><th>url</th><th>state</th><th>seen (s)</th>"
+                    "<th>mem reserved/cap (B)</th><th>revocable (B)</th>"
+                    "<th>blocked</th>"
                     f"</tr>{wrows}</table>"
                     f"<h3>queries ({nqueries})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"

@@ -589,6 +589,14 @@ class Engine:
             f"{info.get('cpu_ms', 0):.1f} ms, stages: {info.get('stage_count', 0)}, "
             f"task retries: {info.get('task_retries', 0)}"
         )
+        # memory-governance line (reference: QueryStats peakMemoryReservation
+        # + blocked time): peak task reservation, total blocked-on-memory
+        # wall, and how many tasks ran revocation-spilled
+        text.append(
+            f"-- peak memory: {info.get('peak_memory_bytes', 0)} B, "
+            f"blocked on memory: {info.get('memory_blocked_ms', 0.0):.1f} ms, "
+            f"revocations: {info.get('memory_revocations', 0)}"
+        )
         return text
 
     def _target_conn(self, name: str):

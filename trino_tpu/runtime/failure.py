@@ -307,12 +307,23 @@ class FaultInjector:
         matching page-fetch requests with HTTP 503 — the consumer's
         Backoff retries and resumes from its token, so recovery must be
         idempotent.
+      - corrupt_fetch(task_id): CORRUPT flips a byte in the next `count`
+        matching served exchange frames — the consumer's crc32 check must
+        reject the chunk (PAGE_TRANSPORT_ERROR) and re-fetch the same
+        token; a silent wrong-rows result is the failure being tested.
+
+    MEMORY_PRESSURE is consumed at arm time by the worker's
+    /v1/inject_failure handler (it shrinks the node memory pool to the
+    request's `capacity_bytes` immediately), not at a hook point here.
 
     `probability` < 1 arms a probabilistic variant: each match fires with
     that probability using a per-rule seeded rng (deterministic chaos).
     """
 
-    MODES = ("ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP")
+    MODES = (
+        "ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP", "CORRUPT",
+        "MEMORY_PRESSURE",
+    )
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -377,3 +388,14 @@ class FaultInjector:
     def drop_fetch(self, task_id: str) -> bool:
         """True == answer this page-fetch request with a transient 503."""
         return self._take(task_id, ("EXCHANGE_DROP",)) is not None
+
+    def corrupt_fetch(self, task_id: str) -> bool:
+        """True == flip a byte in the exchange frame served for this
+        page-fetch request (end-to-end integrity check exercise)."""
+        return self._take(task_id, ("CORRUPT",)) is not None
+
+    def record_fired(self, mode: str, task_id: str) -> None:
+        """Observability entry for faults applied outside _take (e.g.
+        MEMORY_PRESSURE, consumed at arm time by the worker handler)."""
+        with self._lock:
+            self.fired.append((mode, task_id))

@@ -149,6 +149,38 @@ PROPERTIES: dict[str, _Prop] = {
             None,
         ),
         _Prop(
+            "task_memory_reserve_bytes", int, 0,
+            "bytes each task reserves from its worker's NodeMemoryPool "
+            "before execution (reference: MemoryPool.reserve via the "
+            "per-operator LocalMemoryContext chain); 0 = no reservation. "
+            "A full pool parks the task BLOCKED until a peer frees",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "memory_blocked_timeout_s", float, 60.0,
+            "how long a task may sit blocked-on-memory before the wait "
+            "escalates to a typed MemoryExceeded failure (reference: the "
+            "cluster memory manager's blocked-nodes accounting); 0 = wait "
+            "forever",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "low_memory_killer_delay_s", float, 5.0,
+            "grace period a node may stay over budget (or hold blocked "
+            "tasks) before the coordinator's low-memory killer acts "
+            "(reference: low-memory-killer.delay + "
+            "TotalReservationLowMemoryKiller)",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "memory_revocation_enabled", bool, True,
+            "try revoking revocable memory (forcing partitioned / spilled "
+            "execution, exec/spill.py) on pressured nodes BEFORE killing "
+            "the largest query (reference: revocable memory + "
+            "spill-to-disk ahead of the OOM killer)",
+            None,
+        ),
+        _Prop(
             "query_max_memory_bytes", int, 0,
             "device-memory budget per query; 0 = auto (~80% of the "
             "accelerator's reported HBM), -1 = unlimited (never reroute). "

@@ -41,6 +41,24 @@ SPOOL_URL = "spool"
 _MARKER = "COMMITTED"
 
 
+def _verify_spool_frame(task_id: str, buffer_id: int, name: str, blob: bytes) -> None:
+    """Spooled chunks carry the wire integrity frame (runtime/wire.py) —
+    verify the crc32 at read time so silent disk corruption surfaces as a
+    typed PAGE_TRANSPORT_ERROR instead of wrong rows.  The framed bytes are
+    returned as-is: downstream wire_to_page unframes.  Unframed blobs
+    (legacy spool dirs, unit tests writing raw serde bytes) pass through."""
+    from .wire import FRAME_MAGIC, PageTransportError, unframe_chunk
+
+    if blob[:4] == FRAME_MAGIC:
+        try:
+            unframe_chunk(blob)
+        except PageTransportError as e:
+            e.args = (
+                f"spool chunk {task_id}/buf{buffer_id}/{name}: {e.args[0]}",
+            )
+            raise
+
+
 class SpooledExchange:
     def __init__(self, directory: str):
         self.dir = directory
@@ -99,7 +117,9 @@ class SpooledExchange:
         for name in sorted(os.listdir(bdir)):
             if name.endswith(".bin"):
                 with open(os.path.join(bdir, name), "rb") as f:
-                    out.append(f.read())
+                    blob = f.read()
+                _verify_spool_frame(task_id, buffer_id, name, blob)
+                out.append(blob)
         return out
 
     # -------------------------------------------------------------- cleanup

@@ -7,6 +7,8 @@ receiving task — the reference's PagesSerdes + PositionsAppender path
 
 from __future__ import annotations
 
+import struct
+import zlib
 from typing import Sequence
 
 import numpy as np
@@ -16,15 +18,67 @@ from ..data.types import Type
 from ..native import page_serde
 from ..ops.expr import column_val, eval_expr
 from ..plan.ir import IrExpr
+from ..utils.metrics import GLOBAL as _METRICS
 
 __all__ = [
     "page_to_wire", "page_to_wire_chunks", "wire_to_page", "partition_page",
+    "frame_chunk", "unframe_chunk", "PageTransportError", "FRAME_MAGIC",
 ]
 
 # Target rows per wire chunk: bounds single HTTP transfers and lets the
 # consumer acknowledge-and-free incrementally (the reference bounds transfer
 # by bytes via exchange.max-response-size; rows are our natural unit).
 CHUNK_ROWS = 262_144
+
+# ---------------------------------------------------------- page integrity
+# Every wire chunk carries an end-to-end integrity frame: 4-byte magic +
+# little-endian crc32 of the payload (reference: PagesSerde XXH64 page
+# checksums, serde/PagesSerdeUtil).  The frame survives every hop — worker
+# output buffer, HTTP exchange fetch, spool commit file, out-of-core spill
+# file — so a flipped bit anywhere between producer serialization and
+# consumer deserialization surfaces as a typed PAGE_TRANSPORT_ERROR instead
+# of silently wrong rows, and the fetch path retries through the existing
+# token-resume machinery.
+FRAME_MAGIC = b"TPG1"
+_FRAME_HEADER = len(FRAME_MAGIC) + 4
+
+_TRANSPORT_ERRORS = _METRICS.counter(
+    "trino_tpu_page_transport_errors_total",
+    "Exchange frames rejected by crc32 verification",
+)
+
+
+class PageTransportError(RuntimeError):
+    """A wire chunk failed integrity verification (bad magic or crc32
+    mismatch).  Message carries the [PAGE_TRANSPORT_ERROR] error code."""
+
+    def __init__(self, detail: str):
+        super().__init__(f"{detail} [PAGE_TRANSPORT_ERROR]")
+
+
+def frame_chunk(blob: bytes) -> bytes:
+    """magic + crc32(payload) + payload."""
+    return FRAME_MAGIC + struct.pack("<I", zlib.crc32(blob) & 0xFFFFFFFF) + blob
+
+
+def unframe_chunk(framed: bytes) -> bytes:
+    """Verify and strip the integrity frame; raises PageTransportError on
+    bad magic, truncated header, or checksum mismatch."""
+    if len(framed) < _FRAME_HEADER or framed[:4] != FRAME_MAGIC:
+        _TRANSPORT_ERRORS.inc()
+        raise PageTransportError(
+            f"wire chunk missing integrity frame "
+            f"(len={len(framed)}, head={framed[:4]!r})"
+        )
+    (want,) = struct.unpack_from("<I", framed, 4)
+    payload = framed[_FRAME_HEADER:]
+    got = zlib.crc32(payload) & 0xFFFFFFFF
+    if got != want:
+        _TRANSPORT_ERRORS.inc()
+        raise PageTransportError(
+            f"wire chunk crc32 mismatch: expected {want:#010x}, got {got:#010x}"
+        )
+    return payload
 
 
 def _host_columns(page: Page) -> tuple[list[np.ndarray], list, list, np.ndarray]:
@@ -82,7 +136,7 @@ def page_to_wire(page: Page, row_mask: np.ndarray = None) -> bytes:
             cols[f"v{i:04d}"] = v
         if d2 is not None:
             cols[f"d{i:04d}"] = d2
-    return page_serde().serialize_columns(cols)
+    return frame_chunk(page_serde().serialize_columns(cols))
 
 
 def page_to_wire_chunks(page: Page, chunk_rows: int = 0) -> list[bytes]:
@@ -103,7 +157,7 @@ def page_to_wire_chunks(page: Page, chunk_rows: int = 0) -> list[bytes]:
                 cols[f"v{i:04d}"] = v[sl]
             if d2 is not None:
                 cols[f"d{i:04d}"] = d2[sl]
-        out.append(page_serde().serialize_columns(cols))
+        out.append(frame_chunk(page_serde().serialize_columns(cols)))
     return out
 
 
@@ -113,7 +167,11 @@ def _chunk_blob_columns(cols_p: dict, n: int, chunk_rows: int) -> list[bytes]:
     for c in range(nchunks):
         sl = slice(c * chunk_rows, min((c + 1) * chunk_rows, n))
         out.append(
-            page_serde().serialize_columns({k: v[sl] for k, v in cols_p.items()})
+            frame_chunk(
+                page_serde().serialize_columns(
+                    {k: v[sl] for k, v in cols_p.items()}
+                )
+            )
         )
     return out
 
@@ -129,7 +187,14 @@ def wire_to_page(
     compiled shape classes (the out-of-core executor runs P slices through
     one jit cache this way)."""
     serde = page_serde()
-    parts = [serde.deserialize_columns(b) for b in blobs]
+    # unframe_chunk verifies each blob's crc32; blobs arriving without a
+    # frame (unit tests feeding raw serde output) pass through untouched
+    parts = [
+        serde.deserialize_columns(
+            unframe_chunk(b) if b[:4] == FRAME_MAGIC else b
+        )
+        for b in blobs
+    ]
     total = sum(
         len(p[f"c{0:04d}"]) for p in parts if f"c{0:04d}" in p
     ) if types else 0

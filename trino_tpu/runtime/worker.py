@@ -30,8 +30,13 @@ Reference wiring this replaces (SURVEY §2.8, §3.2-3.3):
                               serving until consumers are done, then the
                               worker deregisters (server/GracefulShutdownHandler
                               + NodeStateChangeHandler PUT /v1/info/state)
+  POST /v1/memory/revoke      cluster-memory-manager revocation request:
+                              force-spill the query's revocable leases on
+                              this node (reference: the revoke-memory task
+                              update that triggers spillable operators)
   POST /v1/inject_failure     test-only fault matrix (ERROR | TIMEOUT |
-                              SLOW | EXCHANGE_DROP, counted/probabilistic;
+                              SLOW | EXCHANGE_DROP | CORRUPT |
+                              MEMORY_PRESSURE, counted/probabilistic;
                               execution/FailureInjector.java:33 — see
                               runtime/failure.py FaultInjector)
 
@@ -61,10 +66,34 @@ from ..plan.serde import plan_from_json
 from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env
 from .failure import Backoff, FaultInjector
+from .memory import NodeMemoryPool
 from .spool import SPOOL_URL, SpooledExchange
-from .wire import page_to_wire_chunks, partition_page, wire_to_page
+from .wire import (
+    PageTransportError,
+    page_to_wire_chunks,
+    partition_page,
+    unframe_chunk,
+    wire_to_page,
+)
 
 __all__ = ["Worker", "DrainingError"]
+
+# sub-slices a revoked task degrades to; matches NodeMemoryPool.revoke_query's
+# default lease shrink factor (the retained lease covers one slice's set)
+REVOKE_SPILL_PARTS = 4
+
+
+def _fragment_revocable(fragment) -> bool:
+    """May this task's reservation be revoked (forced to spill)?  Sliced
+    re-execution needs a TableScan to sub-split, and only fragments with
+    stateful operators (hash agg/join/distinct/topn capacities) hold
+    enough working set to be worth revoking."""
+    from ..plan.nodes import Aggregate, Distinct, Join, TableScan, TopN, walk
+
+    nodes = list(walk(fragment))  # fragment IS the root plan node
+    return any(isinstance(n, TableScan) for n in nodes) and any(
+        isinstance(n, (Aggregate, Distinct, Join, TopN)) for n in nodes
+    )
 
 
 class DrainingError(RuntimeError):
@@ -88,7 +117,14 @@ class _Task:
         # slicing the task id silently breaks per-query memory accounting if
         # the id format ever changes)
         self.query_id = query_id
+        # RUNNING | BLOCKED (parked on node memory) | FINISHED | FAILED
         self.state = "RUNNING"
+        # node-pool reservation (runtime/memory.py MemoryLease); released in
+        # _run_task's finally and on delete — release is idempotent
+        self.mem_lease = None
+        # the cluster memory manager asked this task to force-spill: execute
+        # degrades to sliced (partitioned) execution instead of full-width
+        self.revoke_requested = False
         self.error: Optional[str] = None
         # buffer_id -> list of entries (bytes | path str | None)
         self.buffers: dict[int, list] = {}
@@ -114,7 +150,7 @@ class _Task:
 
     def finish(self, buffers: dict[int, list]) -> None:
         with self.cond:
-            if self.state != "RUNNING":
+            if self.state not in ("RUNNING", "BLOCKED"):
                 return  # watchdog/abort already terminated this attempt
             self.buffers = {k: list(v) for k, v in buffers.items()}
             self.complete = True
@@ -123,11 +159,24 @@ class _Task:
 
     def fail(self, msg: str) -> None:
         with self.cond:
-            if self.state != "RUNNING":
+            if self.state not in ("RUNNING", "BLOCKED"):
                 return  # terminal states absorb (first outcome wins)
             self.state = "FAILED"
             self.error = msg
             self.cond.notify_all()
+
+    def set_blocked(self, blocked: bool) -> None:
+        """Flip RUNNING <-> BLOCKED (parked on node memory) — visible in
+        /v1/task/{id}/status; terminal states absorb."""
+        with self.cond:
+            if blocked and self.state == "RUNNING":
+                self.state = "BLOCKED"
+            elif not blocked and self.state == "BLOCKED":
+                self.state = "RUNNING"
+            self.cond.notify_all()
+        # a just-unparked task must not be killed for the progress it could
+        # not make while legitimately waiting on memory
+        self.progress()
 
 
 class Worker:
@@ -138,11 +187,18 @@ class Worker:
         port: int = 0,
         task_concurrency: int = 4,
         buffer_memory_bytes: Optional[int] = None,
+        node_memory_bytes: Optional[int] = None,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.tasks: dict[str, _Task] = {}
         self.fault_injector = FaultInjector()
+        # node memory pool (reference: the per-node general MemoryPool that
+        # ClusterMemoryManager polls) — capacity from the
+        # `memory.heap-headroom-per-node` config key; None = ungoverned
+        self.memory_pool: Optional[NodeMemoryPool] = (
+            NodeMemoryPool(node_memory_bytes) if node_memory_bytes else None
+        )
         # output-buffer memory bound (reference: OutputBufferMemoryManager):
         # finished chunks past this byte budget spill to a local directory
         # and are served back by file read.  The dir is created eagerly (a
@@ -191,6 +247,22 @@ class Worker:
             "trino_tpu_worker_no_progress_kills_total",
             "Tasks failed by the no-progress watchdog",
         )
+        self._m_revocations = self.metrics.counter(
+            "trino_tpu_memory_revocations_total",
+            "Memory revocations executed (leases force-shrunk to spill)",
+        )
+        self._m_pool_capacity = self.metrics.gauge(
+            "trino_tpu_node_memory_capacity_bytes",
+            "Node memory pool capacity",
+        )
+        self._m_pool_reserved = self.metrics.gauge(
+            "trino_tpu_node_memory_reserved_bytes",
+            "Node memory pool bytes currently reserved",
+        )
+        self._m_pool_blocked = self.metrics.gauge(
+            "trino_tpu_node_memory_blocked_reservations",
+            "Reservations currently parked waiting for pool bytes",
+        )
         self.tracer = Tracer()
         add_exporters_from_env(self.tracer)
         # lifecycle state (reference: NodeState ACTIVE/SHUTTING_DOWN served
@@ -208,6 +280,8 @@ class Worker:
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_port
         self.url = f"http://127.0.0.1:{self.port}"
+        if self.memory_pool is not None:
+            self.memory_pool.name = f"worker:{self.port}"
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     def buffered_bytes(self) -> int:
@@ -470,19 +544,50 @@ class Worker:
             task.fail(str(e))
             self._m_tasks.labels("failed").inc()
         finally:
+            if task.mem_lease is not None:
+                task.mem_lease.release()  # idempotent with delete_task
             self._m_task_seconds.observe(_time.perf_counter() - t0)
 
     def _run_task_inner(self, task: _Task, req: dict, t0: float) -> None:
         import time as _time
 
+        fragment = plan_from_json(req["fragment"])
+
+        # node-pool reservation BEFORE any work touches device memory.  A
+        # full pool parks the task here (state=BLOCKED, visible in /status
+        # and /ui) until a peer query frees bytes — the reference's
+        # non-immediate setBytes future (LocalMemoryContext.java:31) —
+        # escalating to MemoryExceeded past memory_blocked_timeout_s.
+        # Leases over fragments with spillable, scan-sliceable state are
+        # REVOCABLE: the cluster memory manager may force-spill them
+        # instead of killing a query.
+        reserve_bytes = int(req.get("memory_reserve_bytes") or 0)
+        mem_blocked_ms = 0.0
+        if self.memory_pool is not None and reserve_bytes:
+            timeout_s = req.get("memory_blocked_timeout_s")
+            t_r0 = _time.perf_counter()
+            task.mem_lease = self.memory_pool.reserve(
+                task.query_id or task.task_id,
+                reserve_bytes,
+                revocable=_fragment_revocable(fragment),
+                timeout_s=float(timeout_s) if timeout_s else None,
+                what=f"task {task.task_id} reservation",
+                on_block=lambda: task.set_blocked(True),
+                on_unblock=lambda: task.set_blocked(False),
+                on_revoke=lambda: setattr(task, "revoke_requested", True),
+                abort=lambda: task.canceled,
+            )
+            mem_blocked_ms = (_time.perf_counter() - t_r0) * 1e3
+
         # fault matrix (FailureInjector.java:33): ERROR/TIMEOUT raise
         # here, SLOW delays and falls through to normal execution.  A SLOW
         # wedge sits between two progress beats, so the no-progress
         # watchdog sees frozen stats — exactly the wedged-task shape it
-        # exists to catch.
+        # exists to catch.  The hook runs AFTER the reservation: a SLOW
+        # fault holds its bytes while sleeping, which is the deterministic
+        # memory-pressure lever the governance tests lean on.
         self.fault_injector.task_fault(task.task_id)
         task.progress()
-        fragment = plan_from_json(req["fragment"])
         executor = LocalExecutor(self.catalogs, self.default_catalog)
         executor.split = (req["part"], req["num_parts"])
         executor.collect_operator_stats = True
@@ -533,6 +638,9 @@ class Worker:
 
         executor.scan_filters = collect_dynamic_filters(fragment, remote_pages)
 
+        out_kind = req["output_kind"]
+        out_parts = req["out_parts"]
+        revoked = task.revoke_requested and not req.get("analyze")
         if req.get("analyze"):
             # distributed EXPLAIN ANALYZE: the eager node-hook pass adds
             # per-operator wall ms on top of the exact row counts
@@ -541,33 +649,45 @@ class Worker:
             for nid, s in an_stats.items():
                 if "ms" in s:
                     operators.setdefault(nid, {})["ms"] = round(s["ms"], 3)
+        elif revoked:
+            # revocation-driven spill: the cluster memory manager shrank
+            # this task's lease; honor it with sliced (partitioned)
+            # execution so the instantaneous working set matches the
+            # shrunken reservation (exec/spill.py's time-multiplexed idiom)
+            page = None
+            buffers, rows_out, operators = self._execute_sliced(
+                executor, fragment, remote_pages, req, task
+            )
         else:
             page = executor.execute(fragment, remote_pages)
             operators = executor.last_operator_stats
         task.progress()  # execution done — beat before output partitioning
 
-        out_kind = req["output_kind"]
-        out_parts = req["out_parts"]
-        if out_kind == "repartition":
-            from ..plan.serde import _decode
+        if page is not None:
+            if out_kind == "repartition":
+                from ..plan.serde import _decode
 
-            keys = [_decode(k) for k in req["output_keys"]]
-            chunk_lists = partition_page(page, keys, out_parts)
-            buffers = {p: chunks for p, chunks in enumerate(chunk_lists)}
-        else:  # gather / broadcast / single / result
-            buffers = {0: page_to_wire_chunks(page)}
+                keys = [_decode(k) for k in req["output_keys"]]
+                chunk_lists = partition_page(page, keys, out_parts)
+                buffers = {p: chunks for p, chunks in enumerate(chunk_lists)}
+            else:  # gather / broadcast / single / result
+                buffers = {0: page_to_wire_chunks(page)}
+            rows_out = _page_rows(page)
 
         # stats must be on the task BEFORE finish() notifies status waiters
         task.stats = {
             "wall_ms": round((_time.perf_counter() - t0) * 1e3, 3),
             "operators": {str(k): v for k, v in operators.items()},
-            "rows_out": _page_rows(page),
+            "rows_out": rows_out,
             "output_bytes": sum(
                 len(c) for chunks in buffers.values() for c in chunks
             ),
             "exchange_bytes_fetched": fetched_bytes,
             "exchange_rows_fetched": fetched_rows,
             "rows_pruned": executor.rows_pruned,
+            "memory_reserved_bytes": reserve_bytes,
+            "memory_blocked_ms": round(mem_blocked_ms, 3),
+            "memory_revoked": bool(revoked),
         }
 
         if task.canceled:
@@ -599,6 +719,70 @@ class Worker:
             )
         else:
             self._finish_placed(task, buffers)
+
+    def _execute_sliced(
+        self,
+        executor: LocalExecutor,
+        fragment,
+        remote_pages: dict[int, Page],
+        req: dict,
+        task: _Task,
+    ) -> tuple[dict[int, list], int, dict]:
+        """Forced-spill execution after revocation: run this task's split
+        range in REVOKE_SPILL_PARTS sequential sub-slices (exec/spill.py's
+        time-multiplexed out-of-core idiom), so the instantaneous working
+        set is ~1/P of the full-width footprint.  Correct whenever the
+        fragment contains a TableScan: sub-slicing the scan range is
+        indistinguishable from the coordinator having scheduled P× more
+        tasks — partial aggregates / probe slices merge downstream exactly
+        as more tasks would, and exchange inputs (broadcast build sides,
+        dynamic-filter domains) are loop-invariant across slices."""
+        from ..plan.serde import _decode
+
+        part, num_parts = int(req["part"]), int(req["num_parts"])
+        out_kind = req["output_kind"]
+        out_parts = int(req["out_parts"])
+        keys = (
+            [_decode(k) for k in req["output_keys"]]
+            if out_kind == "repartition"
+            else None
+        )
+        # pad slice capacities to powers of two so the P executions share
+        # O(log n) jit shape classes instead of compiling P times
+        executor.pad_splits = True
+        nbuf = out_parts if out_kind == "repartition" else 1
+        buffers: dict[int, list] = {p: [] for p in range(nbuf)}
+        rows_out = 0
+        operators: dict = {}
+        for s in range(REVOKE_SPILL_PARTS):
+            if task.canceled:
+                raise RuntimeError("task canceled")
+            executor.split = (
+                part * REVOKE_SPILL_PARTS + s,
+                num_parts * REVOKE_SPILL_PARTS,
+            )
+            # drop the previous slice's uploaded table columns — holding
+            # them across slices is exactly what revocation forbids
+            executor._table_cols.clear()
+            executor._table_live.clear()
+            page = executor.execute(fragment, remote_pages)
+            rows_out += _page_rows(page)
+            for nid, st in executor.last_operator_stats.items():
+                agg = operators.setdefault(nid, {})
+                for k, v in st.items():
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+                    else:
+                        agg[k] = v
+            if keys is not None:
+                for p, chunks in enumerate(
+                    partition_page(page, keys, out_parts)
+                ):
+                    buffers[p].extend(chunks)
+            else:
+                buffers[0].extend(page_to_wire_chunks(page))
+            task.progress()  # each finished slice is a watchdog beat
+        return buffers, rows_out, operators
 
     # -------------------------------------------------------- buffer access
     def get_chunk(self, task_id: str, buffer_id: int, token: int, wait: float):
@@ -663,7 +847,9 @@ class Worker:
         if task is None:
             return {"state": "UNKNOWN"}
         with task.cond:
-            if task.state == "RUNNING" and wait > 0:
+            # BLOCKED (parked on node memory) is still pending — a status
+            # long-poll keeps waiting through it just like RUNNING
+            if task.state in ("RUNNING", "BLOCKED") and wait > 0:
                 task.cond.wait(timeout=wait)
             st = {"state": task.state, "error": task.error}
             if task.stats:
@@ -676,7 +862,25 @@ class Worker:
         """Prometheus exposition for this worker + the process-global
         registry (spill, caches, SPMD exchange planning)."""
         self._m_buffered.set(self.buffered_bytes())
+        if self.memory_pool is not None:
+            snap = self.memory_pool.snapshot()
+            self._m_pool_capacity.set(snap["capacity"])
+            self._m_pool_reserved.set(snap["reserved"])
+            self._m_pool_blocked.set(snap["blocked"])
         return self.metrics.render(extra=_metrics.GLOBAL)
+
+    def revoke_query_memory(self, query_id: str) -> int:
+        """Execute a coordinator revocation request: force-spill every
+        revocable lease of `query_id` on this node (POST /v1/memory/revoke).
+        Returns bytes freed; 0 when nothing was revocable."""
+        if self.memory_pool is None:
+            return 0
+        freed = self.memory_pool.revoke_query(
+            query_id, spill_parts=REVOKE_SPILL_PARTS
+        )
+        if freed > 0:
+            self._m_revocations.inc()
+        return freed
 
     def _is_local_spill(self, path: str) -> bool:
         return self._spill_dir is not None and path.startswith(self._spill_dir)
@@ -686,6 +890,10 @@ class Worker:
             task = self.tasks.pop(task_id, None)
         if task is not None:
             task.canceled = True
+            # free the node-pool reservation NOW (not at thread exit): a
+            # killed query's bytes must unblock parked peers immediately
+            if task.mem_lease is not None:
+                task.mem_lease.release()
             with task.cond:
                 for chunks in task.buffers.values():
                     for entry in chunks:
@@ -757,6 +965,21 @@ def _stream_fetch(
             continue
         backoff.success()
         if body and not no_data:
+            # end-to-end page integrity: verify the crc32 frame BEFORE the
+            # chunk is appended or acked.  A corrupted frame is transient —
+            # re-fetch the SAME token through the normal resume path (the
+            # producer still holds it: acks only advance past clean chunks).
+            try:
+                unframe_chunk(body)
+            except PageTransportError as e:
+                if backoff.failure():
+                    raise RuntimeError(
+                        f"fetch {task_id}/{buffer_id}/{token} from "
+                        f"{worker_url}: gave up after "
+                        f"{backoff.failure_count} attempts: {e}"
+                    )
+                backoff.sleep()
+                continue
             blobs.append(body)
             token += 1
             if ack:  # free everything below the next token on the producer
@@ -819,6 +1042,14 @@ def _make_handler(worker: Worker):
                         * 1024,
                         "buffered_bytes": sum(by_query.values()),
                         "buffered_by_query": by_query,
+                        # node pool reservations ride the heartbeat
+                        # (reference: MemoryInfo polled by
+                        # ClusterMemoryManager.java:92)
+                        "memory_pool": (
+                            worker.memory_pool.snapshot()
+                            if worker.memory_pool is not None
+                            else None
+                        ),
                     }
                 ).encode()
                 return self._send(200, body, "application/json")
@@ -841,6 +1072,18 @@ def _make_handler(worker: Worker):
                 token = int(parts[5]) if len(parts) >= 6 else 0
                 wait = float(params.get("wait", "0"))
                 code, body, headers = worker.get_chunk(task_id, buffer_id, token, wait)
+                if (
+                    code == 200
+                    and body
+                    and worker.fault_injector.corrupt_fetch(task_id)
+                ):
+                    # CORRUPT: flip one payload byte in the served frame.
+                    # The consumer's crc32 check must reject it and re-fetch
+                    # this token (which serves clean bytes — the rule's
+                    # count is consumed); silence here would be wrong rows.
+                    mut = bytearray(body)
+                    mut[len(mut) // 2] ^= 0xFF
+                    body = bytes(mut)
                 return self._send(code, body, headers=headers)
             return self._send(404, b"not found")
 
@@ -859,8 +1102,30 @@ def _make_handler(worker: Worker):
                         503, str(e).encode(), headers={"Retry-After": "1"}
                     )
                 return self._send(200, b'{"state": "RUNNING"}', "application/json")
+            # POST /v1/memory/revoke {"query_id": ...} — coordinator-driven
+            # revocation: force-spill the query's revocable leases
+            if parts[:3] == ["v1", "memory", "revoke"]:
+                req = json.loads(body)
+                freed = worker.revoke_query_memory(str(req.get("query_id")))
+                return self._send(
+                    200, json.dumps({"freed": freed}).encode(),
+                    "application/json",
+                )
             if parts[:2] == ["v1", "inject_failure"]:
                 req = json.loads(body)
+                if str(req.get("mode", "")).upper() == "MEMORY_PRESSURE":
+                    # consumed at arm time: shrink the node pool NOW; the
+                    # deficit shows as reserved > capacity on the next
+                    # heartbeat and the cluster memory manager escalates
+                    if worker.memory_pool is None:
+                        return self._send(400, b"worker has no memory pool")
+                    worker.memory_pool.set_capacity(
+                        int(req.get("capacity_bytes") or 0)
+                    )
+                    worker.fault_injector.record_fired(
+                        "MEMORY_PRESSURE", req.get("task_id", "*")
+                    )
+                    return self._send(200, b"{}", "application/json")
                 try:
                     worker.fault_injector.arm(
                         task_id=req.get("task_id", "*"),

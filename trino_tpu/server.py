@@ -57,6 +57,7 @@ def main(argv=None) -> int:
     worker = Worker(
         catalogs, default_catalog, port=cfg.port,
         task_concurrency=cfg.task_concurrency,
+        node_memory_bytes=cfg.node_memory_bytes,
     ).start()
     print(f"worker listening on {worker.url}", flush=True)
     if cfg.discovery_uri:

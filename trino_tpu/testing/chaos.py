@@ -19,13 +19,20 @@ from typing import Optional, Sequence
 
 from .runner import DistributedQueryRunner
 
-__all__ = ["ChaosRunner"]
+__all__ = ["ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES"]
 
 # modes that a retry_policy=TASK cluster must absorb without losing the
 # query: ERROR/TIMEOUT fail the task (re-scheduled on another worker),
 # SLOW delays it (no failure at all), EXCHANGE_DROP 503s page fetches
 # (consumer Backoff resumes from its ack token)
 RECOVERABLE_MODES = ("ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP")
+
+# opt-in: CORRUPT flips a byte inside a served page frame — the consumer's
+# crc32 check (runtime/wire.py) must detect it and re-fetch from its ack
+# token, so results stay byte-correct.  Kept out of RECOVERABLE_MODES so
+# existing seeded schedules replay identically; pass
+# modes=CORRUPTION_MODES (or RECOVERABLE_MODES + ("CORRUPT",)) to arm it.
+CORRUPTION_MODES = RECOVERABLE_MODES + ("CORRUPT",)
 
 
 class ChaosRunner:

@@ -29,6 +29,7 @@ class DistributedQueryRunner:
         heartbeat_interval: float = 2.0,
         worker_buffer_memory_bytes: Optional[int] = None,
         cluster_memory_limit_bytes: int = 0,
+        node_memory_bytes: Optional[int] = None,
     ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
@@ -36,6 +37,7 @@ class DistributedQueryRunner:
         self.heartbeat_interval = heartbeat_interval
         self.worker_buffer_memory_bytes = worker_buffer_memory_bytes
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
+        self.node_memory_bytes = node_memory_bytes
         self.coordinator: Optional[Coordinator] = None
         self.workers: list[Worker] = []
 
@@ -54,6 +56,7 @@ class DistributedQueryRunner:
                 self.catalogs,
                 self.default_catalog,
                 buffer_memory_bytes=self.worker_buffer_memory_bytes,
+                node_memory_bytes=self.node_memory_bytes,
             ).start()
             self.workers.append(w)
             # the worker knows its coordinator so a completed drain can
@@ -109,12 +112,16 @@ class DistributedQueryRunner:
         count: int = 1,
         probability: float = 1.0,
         seed: int | None = None,
+        capacity_bytes: int | None = None,
     ) -> None:
         """Arm one rule of the worker's fault matrix (reference:
         TestingTrinoServer.injectTaskFailure, FailureInjector.java).  Modes:
         ERROR (raise), TIMEOUT (sleep delay_ms then raise), SLOW (sleep
         delay_ms then run), EXCHANGE_DROP (503 the next `count` page
-        fetches).  probability<1 arms a seeded probabilistic variant."""
+        fetches), CORRUPT (flip a byte in the next `count` served page
+        frames), MEMORY_PRESSURE (shrink the worker's NodeMemoryPool to
+        `capacity_bytes` immediately).  probability<1 arms a seeded
+        probabilistic variant."""
         w = self.workers[worker_index]
         body = {
             "task_id": task_id,
@@ -125,11 +132,21 @@ class DistributedQueryRunner:
         }
         if seed is not None:
             body["seed"] = seed
+        if capacity_bytes is not None:
+            body["capacity_bytes"] = capacity_bytes
         req = urllib.request.Request(
             f"{w.url}/v1/inject_failure",
             data=json.dumps(body).encode(),
         )
         urllib.request.urlopen(req, timeout=10).read()
+
+    def memory_pressure(self, worker_index: int, capacity_bytes: int) -> None:
+        """Shrink one worker's NodeMemoryPool mid-run — the MEMORY_PRESSURE
+        chaos lever.  Running reservations keep their bytes; new reserve()
+        calls see the reduced capacity and park BLOCKED."""
+        self.inject_task_failure(
+            worker_index, mode="MEMORY_PRESSURE", capacity_bytes=capacity_bytes
+        )
 
     def __enter__(self):
         return self.start()
