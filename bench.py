@@ -385,6 +385,31 @@ def main() -> None:
     if changed:
         emit()
 
+    # hard perf-regression gate (scripts/perf_gate.py): point BENCH_GATE_PREV
+    # at the previous run's BENCH_*.json and any NEW warm regression or
+    # wall-ratio blowup flips this process's exit code — the advisory
+    # warm_regressions list becomes CI-enforceable
+    prev_path = os.environ.get("BENCH_GATE_PREV")
+    if prev_path:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_gate", os.path.join(_REPO, "scripts", "perf_gate.py")
+        )
+        gate = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(gate)
+        try:
+            prev = gate.load(prev_path)
+        except (OSError, ValueError) as e:
+            print(f"perf gate: cannot read {prev_path}: {e}", file=sys.stderr)
+            sys.exit(1)
+        failures = gate.compare(prev, result)
+        if failures:
+            for f in failures:
+                print(f"PERF GATE FAIL {f}", file=sys.stderr)
+            sys.exit(2)
+        print(f"perf gate: ok vs {prev_path}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

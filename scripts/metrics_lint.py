@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Lint the /metrics exposition against itself and the README.
+
+Two failure classes, both exit 2:
+
+1. An exposed metric family is missing `# HELP` text (every instrument
+   in utils/metrics.py takes a help string — an empty one means somebody
+   registered an instrument without documenting it).
+2. A `trino_tpu_*` metric documented in the README does not appear in
+   any scraped exposition — documentation drift, usually a renamed or
+   deleted instrument.
+
+README names are extracted from backtick spans; brace shorthand like
+``trino_tpu_exchange_{fetched,served}_bytes_total`` expands to every
+alternative, while label annotations (``{state=}``, ``{event="x"}``)
+are stripped.
+
+Usage:
+    python scripts/metrics_lint.py [--readme README.md] TARGET...
+
+where each TARGET is an ``http(s)://.../metrics`` URL or a file holding
+a saved exposition.  All targets are unioned before the README check, so
+coordinator-only and worker-only metrics both count as present.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import urllib.request
+
+NAME_RE = re.compile(r"`(trino_tpu_[A-Za-z0-9_{},\"=|]*)`")
+
+
+def fetch(target: str) -> str:
+    if target.startswith(("http://", "https://")):
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            return resp.read().decode()
+    with open(target) as f:
+        return f.read()
+
+
+def parse_exposition(text: str) -> tuple[dict[str, str], set[str]]:
+    """(family -> HELP text, set of family names seen via # TYPE)."""
+    helps: dict[str, str] = {}
+    families: set[str] = set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            helps[name] = help_text.strip()
+            families.add(name)
+        elif line.startswith("# TYPE "):
+            families.add(line[len("# TYPE "):].split()[0])
+    return helps, families
+
+
+def readme_metrics(path: str) -> set[str]:
+    """Every trino_tpu_* metric name the README documents, brace patterns
+    expanded, label annotations stripped."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"metrics_lint: cannot read {path}: {e}", file=sys.stderr)
+        return set()
+    out: set[str] = set()
+    for tok in NAME_RE.findall(text):
+        for name in _expand(tok):
+            if name and not name.endswith("_"):
+                out.add(name)
+    return out
+
+
+def _expand(tok: str) -> list[str]:
+    m = re.search(r"\{([^{}]*)\}", tok)
+    if not m:
+        return [tok]
+    inner = m.group(1)
+    if "=" in inner or '"' in inner:
+        # label annotation, not part of the metric name
+        return _expand(tok[: m.start()] + tok[m.end():])
+    parts = [p.strip() for p in inner.split(",")]
+    out: list[str] = []
+    for p in parts:
+        out.extend(_expand(tok[: m.start()] + p + tok[m.end():]))
+    return out
+
+
+def lint(targets: list[str], readme: str) -> list[str]:
+    failures: list[str] = []
+    all_families: set[str] = set()
+    for target in targets:
+        try:
+            helps, families = parse_exposition(fetch(target))
+        except OSError as e:
+            failures.append(f"cannot scrape {target}: {e}")
+            continue
+        all_families |= families
+        for fam in sorted(families):
+            if not helps.get(fam):
+                failures.append(f"{target}: {fam} has no HELP text")
+    if all_families:  # README drift only checkable with a live scrape
+        for name in sorted(readme_metrics(readme)):
+            if name not in all_families:
+                failures.append(
+                    f"README documents {name} but no scraped target exposes it"
+                )
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="+", help="metrics URLs or saved files")
+    ap.add_argument("--readme", default="README.md")
+    args = ap.parse_args(argv)
+    failures = lint(args.targets, args.readme)
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}")
+        print(f"metrics_lint: {len(failures)} problem(s)")
+        return 2
+    print(f"metrics_lint: ok ({len(args.targets)} target(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # Observability smoke: spins a 2-worker cluster and asserts the whole
 # observability plane end to end — distributed EXPLAIN ANALYZE with
-# per-operator [rows, ms] annotations on every stage, Prometheus /metrics
-# on coordinator AND workers, the /v1/query/{id} QueryInfo endpoint, and
-# traceparent propagation into worker task spans.
+# per-operator [rows, ms] annotations on every stage, the phase ledger
+# and compile-signature attribution, Prometheus /metrics on coordinator
+# AND workers (linted against the README via scripts/metrics_lint.py),
+# the /v1/query listing + /v1/query/{id} QueryInfo endpoints with the
+# history fallback after expiry, and traceparent propagation into worker
+# task spans.
 #
 # Fast enough to run on every runtime/ or exec/ change; the same checks
 # run under the tier-1 gate via tests/test_obs_plane.py.
@@ -55,12 +58,45 @@ try:
         assert "trino_tpu_worker_tasks_total" in wtext
         print(f"worker {w.url} /metrics: {len(wtext.splitlines())} lines ok")
 
+    # documented-vs-exposed drift gate (scripts/metrics_lint.py): every
+    # exposed family must carry HELP text and every README-documented
+    # metric must be exposed by coordinator or a worker
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join("scripts", "metrics_lint.py"))
+    mlint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mlint)
+    targets = [base + "/metrics"] + [w.url + "/metrics" for w in runner.workers]
+    failures = mlint.lint(targets, "README.md")
+    assert not failures, f"metrics lint: {failures}"
+    print(f"metrics_lint: {len(targets)} targets clean")
+
     with coord._lock:
-        qid = sorted(coord.queries)[-1]
+        # newest record (insertion-ordered dict): the inner distributed
+        # query the EXPLAIN ANALYZE statement ran
+        qid = list(coord.queries)[-1]
     info = json.loads(get(f"{base}/v1/query/{qid}"))
     assert info["stage_count"] >= 2 and info["cpu_ms"] > 0
+    ledger = info.get("phase_ledger") or {}
+    assert ledger.get("executing_ms", 0) >= 0 and "compiling_ms" in ledger
+    assert info.get("compile_signatures"), "expected named jit signatures"
     print(f"/v1/query/{qid}: {info['stage_count']} stages, "
-          f"cpu {info['cpu_ms']:.0f} ms ok")
+          f"cpu {info['cpu_ms']:.0f} ms, "
+          f"compile {ledger.get('compiling_ms', 0):.0f} ms ok")
+
+    listing = json.loads(get(base + "/v1/query"))["queries"]
+    assert any(q["query_id"] == qid for q in listing), "listing misses query"
+    print(f"/v1/query: {len(listing)} queries listed")
+
+    # history survives expiry: force-expire the live record, then the
+    # /v1/query/{id} fallback must serve it from the history store
+    coord.expire_query(qid)
+    info2 = json.loads(get(f"{base}/v1/query/{qid}"))
+    assert info2.get("expired"), "expected history fallback after expiry"
+    listing2 = json.loads(get(base + "/v1/query"))["queries"]
+    assert any(q["query_id"] == qid for q in listing2), "history not listed"
+    print(f"/v1/query/{qid} after expiry: served from history ok")
     print("OBS_SMOKE_OK")
 finally:
     runner.stop()

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Hard perf-regression gate over two consecutive BENCH_*.json runs.
+
+Usage:
+    python scripts/perf_gate.py OLD.json NEW.json [--wall-ratio 1.5]
+
+Exit codes:
+    0  no regression
+    1  usage / unreadable input
+    2  regression: the NEW run records warm_regressions absent from the
+       OLD run, or a query's warm wall_s grew past --wall-ratio x OLD
+
+Both the raw ``bench.py --json`` payload and the driver wrapper format
+(``{"n": .., "cmd": .., "rc": .., "tail": .., "parsed": {...}}``) are
+accepted — the gate reaches into ``parsed`` when present.
+
+Gate semantics (deliberate):
+
+* ``warm_regressions`` is compared as a *set of query names*: only
+  regressions NEW introduces fail the gate.  An OLD file predating the
+  field (PR-era formats without it) contributes the empty set — we do
+  NOT recompute bounds from OLD's raw warm_s, because early runs carry
+  cold-compile noise that would mask genuinely new regressions.
+* The wall-ratio check only compares queries present in BOTH runs, so
+  adding a query to the bench suite never trips the gate by itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+DEFAULT_WALL_RATIO = 1.5
+# ignore ratio blowups on sub-50ms walls: scheduler jitter, not perf
+MIN_GATED_WALL_S = 0.05
+
+
+def load(path: str) -> dict:
+    """Parse one BENCH json, unwrapping the driver's {parsed: ...} shell."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a json object")
+    return doc
+
+
+def _regressed_queries(doc: dict) -> set[str]:
+    out = set()
+    for r in doc.get("warm_regressions") or []:
+        if isinstance(r, dict) and r.get("query"):
+            out.add(str(r["query"]))
+        elif isinstance(r, str):
+            out.add(r)
+    return out
+
+
+def compare(old: dict, new: dict, wall_ratio: float = DEFAULT_WALL_RATIO):
+    """Return a list of human-readable failure strings (empty == pass)."""
+    failures: list[str] = []
+
+    fresh = _regressed_queries(new) - _regressed_queries(old)
+    for q in sorted(fresh):
+        detail = next(
+            (
+                r
+                for r in new.get("warm_regressions") or []
+                if isinstance(r, dict) and str(r.get("query")) == q
+            ),
+            {},
+        )
+        failures.append(
+            f"new warm regression: {q} "
+            f"(warm_s {detail.get('warm_s', '?')} > bound {detail.get('bound', '?')})"
+        )
+
+    old_q = old.get("queries") or {}
+    new_q = new.get("queries") or {}
+    if isinstance(old_q, dict) and isinstance(new_q, dict):
+        for q in sorted(set(old_q) & set(new_q)):
+            ow = (old_q[q] or {}).get("wall_s")
+            nw = (new_q[q] or {}).get("wall_s")
+            if not isinstance(ow, (int, float)) or not isinstance(nw, (int, float)):
+                continue
+            if ow < MIN_GATED_WALL_S:
+                continue
+            if nw > ow * wall_ratio:
+                failures.append(
+                    f"wall regression: {q} wall_s {nw:.4f} > "
+                    f"{wall_ratio:.2f}x old {ow:.4f}"
+                )
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--wall-ratio", type=float, default=DEFAULT_WALL_RATIO)
+    args = ap.parse_args(argv)
+    try:
+        old, new = load(args.old), load(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf_gate: cannot read input: {e}", file=sys.stderr)
+        return 1
+    failures = compare(old, new, args.wall_ratio)
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}")
+        print(f"perf_gate: {len(failures)} regression(s) {args.old} -> {args.new}")
+        return 2
+    print(f"perf_gate: ok ({args.old} -> {args.new})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Phase-ledger / flame report over the persistent query history.
+
+Reads the coordinator's history JSONL (TRINO_TPU_HISTORY_FILE, written by
+runtime/history.py) and prints, per query, a flame-style breakdown of
+where the wall went — queued / planning / compiling / executing /
+exchange-wait / spill / blocked-on-memory — plus the per-signature
+compile attribution (which XLA programs the query built, compile wall,
+persistent-cache outcome).  With ``--trace`` it also stitches the JSONL
+span export (TRINO_TPU_TRACE_FILE) for the same query ids and appends
+the span flame underneath (scripts/trace_dump.py idiom).
+
+Usage:
+    python scripts/profile_report.py HISTORY.jsonl [--query QID]
+        [--limit N] [--trace TRACE.jsonl] [--sort wall|compile]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# phase key -> display label, in ledger order
+PHASES = [
+    ("queued_ms", "queued"),
+    ("planning_ms", "planning"),
+    ("starting_ms", "starting"),
+    ("running_ms", "running"),
+    ("compiling_ms", "compiling"),
+    ("executing_ms", "executing"),
+    ("exchange_wait_ms", "exchange-wait"),
+    ("spill_ms", "spill"),
+    ("blocked_on_memory_ms", "blocked-on-memory"),
+    ("finishing_ms", "finishing"),
+]
+
+
+def load_history(path: str) -> list[dict]:
+    """Newest-last records merged by query_id (same replay the store does)."""
+    merged: dict[str, dict] = {}
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        print(f"profile_report: cannot read {path}: {e}", file=sys.stderr)
+        return []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn write
+        qid = rec.get("query_id")
+        if not qid:
+            continue
+        if qid in merged:
+            merged[qid].update(rec)
+            merged[qid] = merged.pop(qid)  # refresh order
+        else:
+            merged[qid] = rec
+    return list(merged.values())
+
+
+def _bar(ms: float, total: float, width: int = 30) -> str:
+    pct = 100.0 * ms / total if total else 0.0
+    return f"{'#' * max(1 if ms > 0 else 0, int(pct * width / 100)):<{width}}"
+
+
+def print_query(rec: dict) -> None:
+    wall_ms = float(rec.get("wall_s") or 0.0) * 1e3
+    sql = str(rec.get("sql") or "")[:100]
+    print(
+        f"=== {rec.get('query_id', '?')}  [{rec.get('state', '?')}]  "
+        f"wall {wall_ms:.1f} ms  rows {rec.get('rows', '?')}"
+    )
+    if sql:
+        print(f"    {sql}")
+    if rec.get("error"):
+        print(f"    error: {rec['error']}")
+    ledger = rec.get("phase_ledger") or {}
+    total = max(wall_ms, 1e-9)
+    for key, label in PHASES:
+        ms = ledger.get(key)
+        if not isinstance(ms, (int, float)) or ms <= 0.0:
+            continue
+        pct = 100.0 * ms / total
+        print(f"    {ms:10.1f} ms {pct:5.1f}% {_bar(ms, total)} {label}")
+    for sig, s in (rec.get("compile_signatures") or {}).items():
+        cache = s.get("cache") or {}
+        cache_txt = ", ".join(f"{k}:{v}" for k, v in sorted(cache.items()) if v)
+        print(
+            f"    compile {sig} x{s.get('compiles', 0)} "
+            f"{float(s.get('compile_s') or 0.0) * 1e3:.1f} ms"
+            + (f" [{cache_txt}]" if cache_txt else "")
+        )
+
+
+def print_trace_for(rec: dict, trace_path: str) -> None:
+    """Append the stitched span flame whose query_id attribute matches."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_dump",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "trace_dump.py"),
+    )
+    td = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(td)
+    qid = rec.get("query_id")
+    for trace in td.stitch(td.load_roots(trace_path)).values():
+        roots = trace["spans"]
+        if not any(
+            (s.get("attributes") or {}).get("query_id") == qid for s in roots
+        ):
+            continue
+        wall = max((s.get("duration_ms", 0.0) for s in roots), default=0.0)
+        print(f"    spans (trace {trace['trace_id']}):")
+        for s in sorted(roots, key=lambda s: -s.get("duration_ms", 0.0)):
+            td.print_flame(s, wall or 1.0, indent=3)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("history", help="history JSONL (TRINO_TPU_HISTORY_FILE)")
+    ap.add_argument("--query", help="only this query_id")
+    ap.add_argument("--limit", type=int, default=20)
+    ap.add_argument("--trace", help="JSONL trace export to stitch in")
+    ap.add_argument("--sort", choices=("wall", "compile"), default="wall")
+    args = ap.parse_args(argv)
+
+    recs = load_history(args.history)
+    if args.query:
+        recs = [r for r in recs if r.get("query_id") == args.query]
+    if not recs:
+        print("no history records found", file=sys.stderr)
+        return 1
+
+    def sort_key(r):
+        if args.sort == "compile":
+            return -float((r.get("phase_ledger") or {}).get("compiling_ms") or 0.0)
+        return -float(r.get("wall_s") or 0.0)
+
+    for rec in sorted(recs, key=sort_key)[: args.limit]:
+        print_query(rec)
+        if args.trace:
+            print_trace_for(rec, args.trace)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

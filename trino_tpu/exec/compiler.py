@@ -142,6 +142,14 @@ class LocalExecutor:
         self.collect_operator_stats = False
         self.last_operator_stats: dict[int, dict] = {}
         self.last_execute_wall_ms: Optional[float] = None
+        # compile/execute attribution (utils/profiler.py): every jit-cache
+        # miss appends {signature, compile_s, cache, flops, bytes_accessed}
+        # here, and execute() rolls the walls spent THIS call into
+        # last_compile_ms/last_execute_ms — the worker ships both on
+        # task.stats and the coordinator folds them into the phase ledger
+        self.compile_events: list[dict] = []
+        self.last_compile_ms = 0.0
+        self.last_execute_ms = 0.0
 
     # ------------------------------------------------------------- table IO
     def table_page(
@@ -272,6 +280,8 @@ class LocalExecutor:
         import time as _time
 
         t0 = _time.perf_counter()
+        self.last_compile_ms = 0.0  # accumulated by _run's jit-cache misses
+        self.last_execute_ms = 0.0
         nodes = _node_ids(plan)
         inputs = {}
         for i, n in nodes.items():
@@ -365,6 +375,13 @@ class LocalExecutor:
                 from .capcache import store_caps
 
                 store_caps(plan, inputs, caps)
+                # execute wall = everything this call that wasn't compile
+                # (table IO, eager sizing, kernel dispatch); the compile
+                # side was accumulated by _run as it hit jit-cache misses
+                wall_s = _time.perf_counter() - t0
+                self.last_execute_ms = max(
+                    0.0, wall_s * 1e3 - self.last_compile_ms
+                )
                 if self.collect_operator_stats:
                     jax.block_until_ready([c.data for c in out_page.columns])
                     self._record_operator_stats(
@@ -399,7 +416,7 @@ class LocalExecutor:
         cache_key = (plan, self.collect_operator_stats,
                      tuple(sorted(caps.items())),
                      tuple(sorted((k, p.capacity) for k, p in inputs.items())))
-        fn, _holder = self._jit_cache[cache_key]
+        fn, _holder, _sig = self._jit_cache[cache_key]
         out, packed = fn(inputs)
         jax.block_until_ready(packed)  # drain any pending work
         # keeping many dispatches in flight also keeps every run's OUTPUT
@@ -576,9 +593,23 @@ class LocalExecutor:
         return page, stats
 
     def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
+        import time as _time
+
+        from ..utils.profiler import PROFILER, cost_summary, signature_of
+
         collect = self.collect_operator_stats
+        # the AOT-compiled entry is pinned to one input pytree + avals
+        # (unlike a lazy jit, which retraces transparently), so the key
+        # must carry the full abstract structure: a None column where a
+        # leaf used to be, or a reshaped dictionary, is a NEW program
+        leaves, treedef = jax.tree_util.tree_flatten(inputs)
+        avals = tuple(
+            (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x).__name__)))
+            for x in leaves
+        )
         cache_key = (plan, collect, tuple(sorted(caps.items())),
-                     tuple(sorted((k, p.capacity) for k, p in inputs.items())))
+                     tuple(sorted((k, p.capacity) for k, p in inputs.items())),
+                     treedef, avals)
         _JIT_CACHE_LOOKUPS.labels(
             "hit" if cache_key in self._jit_cache else "miss"
         ).inc()
@@ -601,12 +632,79 @@ class LocalExecutor:
                 )
                 return out_page, packed
 
-            self._jit_cache[cache_key] = (jax.jit(call), holder)
-        fn, holder = self._jit_cache[cache_key]
+            # AOT lower+compile (instead of letting the first dispatch
+            # compile lazily) so compile wall is measured apart from execute
+            # wall and the backend's cost_analysis() is capturable.  A
+            # capacity-overflow retry lands here again with new caps — a new
+            # SIGNATURE — so a warm-run recompile regression (q03, BENCH_r05)
+            # is attributable to the tier that recompiled, by name.
+            sig = signature_of(plan, caps)
+            entries_before = _pcache_entries()
+            jitted = jax.jit(call)
+            t0 = _time.perf_counter()
+            cost = None
+            try:
+                fn = jitted.lower(inputs).compile()
+                cost = cost_summary(fn)
+            except Exception:
+                # AOT unsupported for this program/backend: fall back to the
+                # lazy jit; its first dispatch below folds compile into
+                # execute wall (attribution degrades, results don't)
+                fn = jitted
+            compile_s = _time.perf_counter() - t0
+            self.last_compile_ms += compile_s * 1e3
+            cache_result = _pcache_result(entries_before, compile_s)
+            PROFILER.record_compile(sig, compile_s, cache_result, cost)
+            event = {
+                "signature": sig, "compile_s": round(compile_s, 4),
+                "cache": cache_result,
+            }
+            if cost:
+                event.update(cost)
+            self.compile_events.append(event)
+            self._jit_cache[cache_key] = (fn, holder, sig)
+        fn, holder, sig = self._jit_cache[cache_key]
+        t0 = _time.perf_counter()
         out_page, packed = fn(inputs)
         vals = np.asarray(packed)  # ONE device->host transfer
+        PROFILER.record_execute(sig, _time.perf_counter() - t0)
         required = dict(zip(holder["keys"], vals.tolist()))
         return out_page, required
+
+
+def _pcache_entries() -> Optional[int]:
+    """On-disk entry count of the persistent XLA cache, or None when the
+    cache is not configured (jit boundaries then report 'uncached')."""
+    try:
+        if not jax.config.jax_compilation_cache_dir:
+            return None
+        from ..utils.compilecache import cache_stats
+
+        return cache_stats()["entries"]
+    except Exception:
+        return None
+
+
+def _pcache_result(entries_before: Optional[int], compile_s: float) -> str:
+    """Infer the persistent-cache outcome of a compile that just finished
+    from the entry-count delta: a fresh compile above the persistence
+    threshold writes an entry (miss); no new entry despite a slow compile
+    means XLA deserialized one from disk (hit); fast compiles never persist
+    and stay ambiguous (uncached)."""
+    if entries_before is None:
+        return "uncached"
+    after = _pcache_entries()
+    if after is None:
+        return "uncached"
+    if after > entries_before:
+        return "miss"
+    try:
+        threshold = float(
+            jax.config.jax_persistent_cache_min_compile_time_secs
+        )
+    except Exception:
+        threshold = 0.1
+    return "hit" if compile_s >= threshold else "uncached"
 
 
 def _est_row_bytes(node: PlanNode) -> int:

@@ -21,6 +21,7 @@ reference's COORDINATOR_DISTRIBUTION output stage
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import traceback
@@ -42,6 +43,7 @@ from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
 from .failure import Backoff, FailureDetector
+from .history import QueryHistoryStore
 from .memory import ClusterMemoryManager
 from .session import SessionProperties
 from .spool import SPOOL_URL, SpooledExchange
@@ -85,6 +87,8 @@ class Coordinator:
         heartbeat_interval: float = 2.0,
         resource_groups=None,
         cluster_memory_limit_bytes: int = 0,  # 0 = no enforcement
+        history_capacity: int = 200,
+        history_path: Optional[str] = None,
     ):
         from .resourcegroups import ResourceGroupManager
 
@@ -173,6 +177,13 @@ class Coordinator:
         # finished queries older than this are expired (record + spooled
         # segments GC'd) by the heartbeat sweep; 0 disables
         self.query_expiration_seconds = 900.0
+        # bounded query history (reference: QueryResource's bounded history
+        # behind GET /v1/query): completed QueryInfo+ledger records survive
+        # _expire_old_queries — and, with a JSONL path, coordinator restarts
+        self.history = QueryHistoryStore(
+            capacity=history_capacity,
+            path=history_path or os.environ.get("TRINO_TPU_HISTORY_FILE"),
+        )
         self._hb_stop = threading.Event()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -570,6 +581,67 @@ class Coordinator:
                     stage_count=int(qi.get("stage_count") or 0),
                 )
             )
+            try:  # history must never fail a finished query
+                self.history.record(self._history_record(record, wall))
+            except Exception:
+                traceback.print_exc()
+
+    def _history_record(self, record: dict, wall_s: float) -> dict:
+        """JSON-able completed-query snapshot for the history store: the
+        QueryInfo (minus the bulky per-stage plan text) plus the final
+        phase ledger — everything /v1/query and profile_report.py need
+        after the live record expires."""
+        sm: QueryStateMachine = record["sm"]
+        qi = dict(record.get("query_info") or {})
+        qi.pop("workers", None)
+        qi["stages"] = [
+            {k: v for k, v in st.items() if k != "plan"}
+            for st in qi.get("stages") or []
+        ]
+        qi["phase_ledger"] = self._phase_ledger(record)  # final state times
+        qi.update({
+            "query_id": sm.query_id,
+            "state": sm.state,
+            "error": sm.error,
+            "error_code": sm.error_code,
+            "sql": (record["sql"] if isinstance(record["sql"], str)
+                    else "<planned>")[:500],
+            "created_ts": sm.created_at,
+            "finished_ts": sm.finished_at,
+            "wall_s": round(wall_s, 4),
+            "rows": len(record["result"] or []),
+        })
+        return qi
+
+    def _phase_ledger(self, record: dict) -> dict:
+        """Per-query time breakdown in ms.  Lifecycle phases come from the
+        state machine's per-state history; compiling / exchange-wait /
+        spill / blocked-on-memory come from the task stats the workers
+        reported (aggregated by _collect_query_info).  ``executing_ms`` is
+        cluster cpu minus attributed compile — kernels + table IO."""
+        sm: QueryStateMachine = record["sm"]
+        phases = sm.phase_seconds()
+        qi = record.get("query_info") or {}
+        compile_ms = float(qi.get("compile_ms") or 0.0)
+        ledger = {
+            "queued_ms": round(phases.get("QUEUED", 0.0) * 1e3, 3),
+            "planning_ms": round(phases.get("PLANNING", 0.0) * 1e3, 3),
+            "starting_ms": round(phases.get("STARTING", 0.0) * 1e3, 3),
+            "running_ms": round(phases.get("RUNNING", 0.0) * 1e3, 3),
+            "finishing_ms": round(phases.get("FINISHING", 0.0) * 1e3, 3),
+            "compiling_ms": round(compile_ms, 3),
+            "executing_ms": round(
+                max(0.0, float(qi.get("cpu_ms") or 0.0) - compile_ms), 3
+            ),
+            "exchange_wait_ms": round(
+                float(qi.get("exchange_wait_ms") or 0.0), 3
+            ),
+            "spill_ms": round(float(qi.get("spill_ms") or 0.0), 3),
+            "blocked_on_memory_ms": round(
+                float(qi.get("memory_blocked_ms") or 0.0), 3
+            ),
+        }
+        return ledger
 
     def _run_inner(self, record: dict) -> None:
         sm: QueryStateMachine = record["sm"]
@@ -992,6 +1064,29 @@ class Coordinator:
         peak_mem = 0
         mem_blocked_ms = 0.0
         mem_revocations = 0
+        compile_ms = 0.0
+        exchange_wait_ms = 0.0
+        spill_ms = 0.0
+        # named jit signatures merged across every task (utils/profiler.py):
+        # sig -> {compiles, compile_s, cache: {hit, miss, uncached}}
+        compile_sigs: dict[str, dict] = {}
+
+        def merge_compile_events(events) -> None:
+            for ev in events or []:
+                sig = ev.get("signature") or "?"
+                agg = compile_sigs.setdefault(
+                    sig,
+                    {"compiles": 0, "compile_s": 0.0,
+                     "cache": {"hit": 0, "miss": 0, "uncached": 0}},
+                )
+                agg["compiles"] += 1
+                agg["compile_s"] = round(
+                    agg["compile_s"] + float(ev.get("compile_s") or 0.0), 4
+                )
+                cache = ev.get("cache")
+                if cache in agg["cache"]:
+                    agg["cache"][cache] += 1
+
         for f in sorted(fragments, key=lambda fr: fr.id):
             ops: dict[int, dict] = {}
             task_infos = []
@@ -999,11 +1094,17 @@ class Coordinator:
                 for nid, s in root_executor.last_operator_stats.items():
                     ops[int(nid)] = dict(s)
                 wall = root_executor.last_execute_wall_ms or 0.0
+                root_compile = getattr(root_executor, "last_compile_ms", 0.0)
                 task_infos.append(
                     {"worker": "coordinator", "task_id": f"{sm.query_id}_root",
-                     "wall_ms": round(wall, 3)}
+                     "wall_ms": round(wall, 3),
+                     "compile_ms": round(root_compile, 3)}
                 )
                 cpu_ms += wall
+                compile_ms += root_compile
+                merge_compile_events(
+                    getattr(root_executor, "compile_events", None)
+                )
             else:
                 for (url, task_id) in task_urls.get(f.id, []):
                     if url == SPOOL_URL:
@@ -1021,9 +1122,15 @@ class Coordinator:
                         "exchange_bytes_fetched": st.get("exchange_bytes_fetched"),
                         "exchange_bytes_served": st.get("exchange_bytes_served"),
                         "rows_pruned": st.get("rows_pruned"),
+                        "compile_ms": st.get("compile_ms"),
+                        "exchange_wait_ms": st.get("exchange_wait_ms"),
                     }
                     task_infos.append(ti)
                     cpu_ms += float(st.get("wall_ms") or 0.0)
+                    compile_ms += float(st.get("compile_ms") or 0.0)
+                    exchange_wait_ms += float(st.get("exchange_wait_ms") or 0.0)
+                    spill_ms += float(st.get("spill_ms") or 0.0)
+                    merge_compile_events(st.get("compile_events"))
                     peak_mem = max(
                         peak_mem,
                         int(st.get("output_bytes") or 0),
@@ -1073,6 +1180,10 @@ class Coordinator:
             "peak_memory_bytes": peak_mem,
             "memory_blocked_ms": round(mem_blocked_ms, 3),
             "memory_revocations": mem_revocations,
+            "compile_ms": round(compile_ms, 3),
+            "exchange_wait_ms": round(exchange_wait_ms, 3),
+            "spill_ms": round(spill_ms, 3),
+            "compile_signatures": compile_sigs,
             "wall_ms": round((time.perf_counter() - t_query0) * 1e3, 3),
             "output_rows": len(record["result"] or []),
             "task_retries": record.get("task_retries", 0),
@@ -1080,6 +1191,10 @@ class Coordinator:
             "trace_id": record.get("trace_id", ""),
             "workers": self.failure_detector.snapshot(),
         }
+        # the phase ledger rides QueryInfo (reference: QueryStats planning/
+        # execution/queued durations on GET /v1/query/{id}) and the EXPLAIN
+        # ANALYZE footer; final state durations are refreshed at history time
+        record["query_info"]["phase_ledger"] = self._phase_ledger(record)
 
     def _task_info(self, worker_url: str, task_id: str) -> dict:
         """Full task-status JSON (state + stats); {} when unreachable."""
@@ -1634,8 +1749,12 @@ def _make_handler(coord: Coordinator):
             return self._send_json(404, {"error": "not found"})
 
         def do_GET(self):
-            parts = self.path.strip("/").split("/")
-            if self.path in ("/ui", "/ui/", "/"):
+            from urllib.parse import parse_qs
+
+            path, _, qs = self.path.partition("?")
+            parts = path.strip("/").split("/")
+            params = parse_qs(qs)
+            if path in ("/ui", "/ui/", "/"):
                 # minimal cluster/query dashboard (reference: core/trino-web-ui
                 # React app + server/ui/ClusterStatsResource; here one
                 # self-refreshing page over the same coordinator state)
@@ -1687,6 +1806,15 @@ def _make_handler(coord: Coordinator):
                     )
                     nworkers = len(coord.workers)
                     nqueries = len(coord.queries)
+                # history has its own lock — render outside coord._lock
+                hrows = "".join(
+                    f"<tr><td>{_html.escape(str(h.get('query_id')))}</td>"
+                    f"<td>{_html.escape(str(h.get('state')))}</td>"
+                    f"<td>{float(h.get('wall_s') or 0.0):.2f}</td>"
+                    f"<td>{float((h.get('phase_ledger') or {}).get('compiling_ms') or 0.0):.0f}</td>"
+                    f"<td><code>{_html.escape(str(h.get('sql'))[:120])}</code></td></tr>"
+                    for h in coord.history.list(limit=20)
+                )
                 body = (
                     "<!doctype html><html><head><meta charset='utf-8'>"
                     "<meta http-equiv='refresh' content='3'>"
@@ -1702,7 +1830,11 @@ def _make_handler(coord: Coordinator):
                     f"<h3>queries ({nqueries})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
                     "<th>in state (s)</th><th>sql</th></tr>"
-                    f"{qrows}</table></body></html>"
+                    f"{qrows}</table>"
+                    f"<h3>history ({len(coord.history)})</h3>"
+                    "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
+                    "<th>compile (ms)</th><th>sql</th></tr>"
+                    f"{hrows}</table></body></html>"
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/html")
@@ -1732,24 +1864,83 @@ def _make_handler(coord: Coordinator):
                         "resource_groups": coord.resource_groups.stats(),
                     },
                 )
+            if parts[:2] == ["v1", "query"] and len(parts) == 2:
+                # query listing, live table overlaid on the bounded history
+                # (reference: server QueryResource GET /v1/query with its
+                # state filter); ?state=FINISHED&limit=50
+                state = (params.get("state") or [None])[0]
+                try:
+                    limit = int((params.get("limit") or ["50"])[0])
+                except ValueError:
+                    limit = 50
+                with coord._lock:
+                    live = [
+                        {
+                            "query_id": qid,
+                            "state": rec["sm"].state,
+                            "sql": str(rec.get("sql"))[:200],
+                            "created_ts": rec["sm"].created_at,
+                            "wall_s": round(
+                                (rec["sm"].finished_at or time.time())
+                                - rec["sm"].created_at, 3
+                            ),
+                            "error": rec["sm"].error,
+                            "source": "live",
+                        }
+                        for qid, rec in coord.queries.items()
+                    ]
+                seen = {q["query_id"] for q in live}
+                rows = [
+                    dict(
+                        {k: h.get(k) for k in (
+                            "query_id", "state", "sql", "created_ts",
+                            "wall_s", "error",
+                        )},
+                        source="history",
+                    )
+                    for h in coord.history.list(limit=coord.history.capacity)
+                    if h.get("query_id") not in seen
+                ] + live
+                if state:
+                    want = state.upper()
+                    rows = [
+                        q for q in rows
+                        if str(q.get("state", "")).upper() == want
+                    ]
+                rows.sort(key=lambda q: q.get("created_ts") or 0.0,
+                          reverse=True)
+                return self._send_json(200, {"queries": rows[:max(0, limit)]})
             if parts[:2] == ["v1", "query"] and len(parts) == 3:
                 # QueryInfo: stages, tasks, operator stats, retry counters
-                # (reference: server QueryResource GET /v1/query/{queryId})
+                # (reference: server QueryResource GET /v1/query/{queryId}).
+                # The response dict is assembled UNDER the lock (cheap dict
+                # copies) and serialized OUTSIDE it — a slow client reading
+                # the body must never stall the heartbeat sweep.
+                info = None
                 with coord._lock:
                     record = coord.queries.get(parts[2])
-                if record is None:
-                    return self._send_json(404, {"error": "unknown query"})
-                info = dict(record.get("query_info") or {})
-                info.update(
-                    {
-                        "query_id": parts[2],
-                        "state": record["sm"].state,
-                        "error": record["sm"].error,
-                        "task_retries": record.get("task_retries", 0),
-                        "task_heals": record.get("task_heals", 0),
-                        "stage_times": record.get("stage_times") or {},
-                    }
-                )
+                    if record is not None:
+                        info = dict(record.get("query_info") or {})
+                        info.update(
+                            {
+                                "query_id": parts[2],
+                                "state": record["sm"].state,
+                                "error": record["sm"].error,
+                                "task_retries": record.get("task_retries", 0),
+                                "task_heals": record.get("task_heals", 0),
+                                "stage_times": dict(
+                                    record.get("stage_times") or {}
+                                ),
+                            }
+                        )
+                if info is None:
+                    # expired from the live table: serve the history record
+                    # instead of 404ing (reference: QueryResource keeps
+                    # answering for min-expire-age after completion)
+                    hist = coord.history.get(parts[2])
+                    if hist is None:
+                        return self._send_json(404, {"error": "unknown query"})
+                    info = dict(hist, expired=True)
                 return self._send_json(200, info)
             if parts[:2] == ["v1", "query"] and len(parts) >= 4 and parts[3] == "state":
                 # cheap state probe: never serializes result rows

@@ -522,6 +522,10 @@ class Engine:
             if _has_host_aggs(plan):
                 ex = self._local_fallback  # plan came back undistributed
         if hasattr(ex, "explain_analyze"):
+            # the engine's executor is long-lived: remember where its
+            # compile ledger stood so the footer shows only THIS
+            # statement's jit signatures
+            n_ev0 = len(getattr(ex, "compile_events", []) or [])
             page, stats = ex.explain_analyze(plan)
             wall = _time.perf_counter() - t0
             if fmt == "json":
@@ -552,12 +556,47 @@ class Engine:
             text.append(
                 f"-- output rows: {len(page.to_pylist())}, wall: {wall * 1000:.1f} ms"
             )
+            text.extend(self._profile_footer(ex, n_ev0))
             return [(line,) for line in text]
         rows = self.query(stmt.query)
         wall = _time.perf_counter() - t0
         text = format_plan(plan).splitlines()
         text.append(f"-- output rows: {len(rows)}, wall: {wall * 1000:.1f} ms")
         return [(line,) for line in text]
+
+    @staticmethod
+    def _profile_footer(ex, n_ev0: int = 0) -> list[str]:
+        """Compile/execute attribution footer (utils/profiler.py): the jit
+        signatures this statement built, XLA compile wall vs dispatch wall,
+        persistent-cache outcome, and the program-level roofline (flops /
+        bytes-accessed from ``compiled.cost_analysis()`` over the execute
+        wall).  ``n_ev0`` marks where the executor's cumulative compile
+        ledger stood before the statement ran."""
+        events = list(getattr(ex, "compile_events", []) or [])[n_ev0:]
+        compile_ms = getattr(ex, "last_compile_ms", 0.0)
+        execute_ms = getattr(ex, "last_execute_ms", 0.0)
+        if not events and compile_ms <= 0.0 and execute_ms <= 0.0:
+            return []
+        out = [
+            f"-- phases: compile {compile_ms:.1f} ms, execute {execute_ms:.1f} ms"
+        ]
+        for ev in events:
+            out.append(
+                f"-- compile: {ev.get('signature', '?')} "
+                f"{ev.get('compile_s', 0.0) * 1e3:.1f} ms "
+                f"[persistent cache: {ev.get('cache', 'uncached')}]"
+            )
+            flops = ev.get("flops") or 0.0
+            byts = ev.get("bytes_accessed") or 0.0
+            if execute_ms > 0.0 and (flops or byts):
+                ex_s = execute_ms / 1e3
+                out.append(
+                    f"-- roofline: {ev.get('signature', '?')} "
+                    f"{flops / ex_s / 1e9:.3f} GFLOP/s, "
+                    f"{byts / ex_s / 1e9:.3f} GB/s achieved "
+                    f"over {execute_ms:.1f} ms execute"
+                )
+        return out
 
     @staticmethod
     def _render_distributed_analyze(info: dict, wall_s: float) -> list[str]:
@@ -597,6 +636,30 @@ class Engine:
             f"blocked on memory: {info.get('memory_blocked_ms', 0.0):.1f} ms, "
             f"revocations: {info.get('memory_revocations', 0)}"
         )
+        # phase ledger footer (reference: QueryStats' queued/analysis/
+        # planning/execution durations): where the wall actually went
+        ledger = info.get("phase_ledger") or {}
+        if ledger:
+            text.append(
+                "-- phases: "
+                + ", ".join(
+                    f"{k[: -len('_ms')]} {v:.1f} ms"
+                    for k, v in ledger.items()
+                    if isinstance(v, (int, float))
+                )
+            )
+        # per-signature compile attribution: every distinct XLA program
+        # the query built, with its persistent-cache outcome breakdown
+        for sig, s in (info.get("compile_signatures") or {}).items():
+            cache = s.get("cache") or {}
+            cache_txt = ", ".join(
+                f"{k}: {v}" for k, v in sorted(cache.items()) if v
+            )
+            text.append(
+                f"-- compile: {sig} x{s.get('compiles', 0)} "
+                f"{s.get('compile_s', 0.0) * 1e3:.1f} ms"
+                + (f" [persistent cache: {cache_txt}]" if cache_txt else "")
+            )
         return text
 
     def _target_conn(self, name: str):

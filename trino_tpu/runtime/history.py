@@ -1,0 +1,136 @@
+"""Bounded, persistent query history.
+
+Reference: the engine keeps every recent query's QueryInfo in a bounded
+in-memory history behind ``GET /v1/query`` (server QueryResource over
+DispatchManager; ``query.max-history`` / ``query.min-expire-age`` bound
+it) — the Web UI's query list and "why was last night's run slow" both
+read from it.  Our coordinator's live table drops a query entirely at
+``_expire_old_queries`` (+15 min), which is exactly when somebody starts
+asking questions about it.
+
+``QueryHistoryStore`` is the answer: an insertion-ordered ring of
+completed query records (dict snapshots of QueryInfo + the phase ledger)
+capped at ``capacity``, optionally mirrored to a JSONL file so history
+survives a coordinator restart — the constructor replays the tail of the
+file back into the ring.  Records merge by query_id (a later, richer
+record updates the earlier one in place), so the store can also serve as
+an EventListener (``store(event)``): Engine users get a minimal history
+for free, and the coordinator overlays its full QueryInfo snapshot.
+
+Thread-safety: one lock around the ring; JSONL writes append a single
+line under the same lock (O_APPEND semantics keep concurrent processes
+from interleaving partial lines).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+__all__ = ["QueryHistoryStore"]
+
+
+class QueryHistoryStore:
+    def __init__(self, capacity: int = 200, path: Optional[str] = None):
+        self.capacity = max(1, int(capacity))
+        self.path = path
+        self._lock = threading.Lock()
+        self._ring: OrderedDict[str, dict] = OrderedDict()
+        if path:
+            self._load(path)
+
+    # ------------------------------------------------------------------ io
+    def _load(self, path: str) -> None:
+        """Replay the JSONL tail into the ring (restart survival).  Records
+        merge by query_id, so an interrupted run's duplicate lines coalesce
+        instead of double-counting."""
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            return
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write at crash: skip, don't die
+            qid = rec.get("query_id")
+            if qid:
+                self._merge(qid, rec, persist=False)
+
+    def _append_line(self, rec: dict) -> None:
+        if not self.path:
+            return
+        try:
+            line = json.dumps(rec, default=str)
+        except (TypeError, ValueError):
+            return
+        try:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass  # read-only disk: in-memory history still works
+
+    # -------------------------------------------------------------- record
+    def _merge(self, qid: str, rec: dict, persist: bool) -> None:
+        existing = self._ring.pop(qid, None)
+        if existing is not None:
+            existing.update(rec)
+            rec = existing
+        self._ring[qid] = rec  # (re-)insert at the fresh end
+        while len(self._ring) > self.capacity:
+            self._ring.popitem(last=False)  # evict oldest
+        if persist:
+            self._append_line(rec)
+
+    def record(self, rec: dict) -> None:
+        """Insert/merge a completed-query record (must be JSON-able and
+        carry ``query_id``)."""
+        qid = rec.get("query_id")
+        if not qid:
+            return
+        with self._lock:
+            self._merge(qid, dict(rec), persist=True)
+
+    def __call__(self, event) -> None:
+        """EventListener duty (runtime/events.py): completed/failed events
+        become minimal history records — richer coordinator snapshots merge
+        over them by query_id."""
+        if getattr(event, "kind", None) not in ("completed", "failed"):
+            return
+        self.record({
+            "query_id": event.query_id,
+            "state": "FINISHED" if event.kind == "completed" else "FAILED",
+            "sql": event.sql,
+            "wall_s": event.wall_s,
+            "rows": event.rows,
+            "error": event.error,
+            "cpu_ms": event.cpu_ms,
+            "peak_memory_bytes": event.peak_memory_bytes,
+            "stage_count": event.stage_count,
+            "finished_ts": event.ts,
+        })
+
+    # ---------------------------------------------------------------- read
+    def get(self, qid: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._ring.get(qid)
+            return dict(rec) if rec is not None else None
+
+    def list(self, state: Optional[str] = None, limit: int = 50) -> list[dict]:
+        """Newest-first records, optionally filtered by terminal state."""
+        with self._lock:
+            recs = [dict(r) for r in reversed(self._ring.values())]
+        if state:
+            want = state.upper()
+            recs = [r for r in recs if str(r.get("state", "")).upper() == want]
+        return recs[: max(0, int(limit))]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)

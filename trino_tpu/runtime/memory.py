@@ -62,6 +62,23 @@ _BLOCKED_SECONDS = _METRICS.histogram(
     "Time reservations spent parked waiting for pool bytes",
     buckets=(0.001, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0),
 )
+# per-pool gauges (reference: MemoryPoolMXBean's free/reserved bytes the
+# cluster manager polls), refreshed on every snapshot()
+_POOL_CAPACITY = _METRICS.gauge(
+    "trino_tpu_memory_pool_capacity",
+    "Pool byte budget",
+    labelnames=("pool",),
+)
+_POOL_RESERVED = _METRICS.gauge(
+    "trino_tpu_memory_pool_reserved",
+    "Bytes currently reserved in the pool",
+    labelnames=("pool",),
+)
+_POOL_BLOCKED = _METRICS.gauge(
+    "trino_tpu_memory_pool_blocked_reservations",
+    "Reservations parked waiting for pool bytes",
+    labelnames=("pool",),
+)
 
 
 class MemoryExceeded(RuntimeError):
@@ -358,6 +375,9 @@ class NodeMemoryPool:
                 q["reserved"] += lease.nbytes
                 if lease.revocable and not lease.revoked:
                     q["revocable"] += lease.nbytes
+            _POOL_CAPACITY.labels(self.name).set(self.capacity)
+            _POOL_RESERVED.labels(self.name).set(self.reserved)
+            _POOL_BLOCKED.labels(self.name).set(self.blocked)
             return {
                 "capacity": self.capacity,
                 "reserved": self.reserved,

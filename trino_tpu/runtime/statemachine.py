@@ -41,6 +41,12 @@ class QueryStateMachine:
         self.created_at = time.time()
         self.finished_at: Optional[float] = None
         self.state_changed_at = self.created_at  # /ui "in state for" column
+        # entry timestamp per visited state, in visit order — the raw
+        # material of the phase ledger (reference: QueryStateTimer's
+        # elapsed/planning/execution durations on QueryStats)
+        self.state_history: list[tuple[str, float]] = [
+            ("QUEUED", self.created_at)
+        ]
 
     @property
     def state(self) -> str:
@@ -66,12 +72,29 @@ class QueryStateMachine:
                 return False
             self._state = new_state
             self.state_changed_at = time.time()
+            self.state_history.append((new_state, self.state_changed_at))
             if new_state in TERMINAL:
                 self.finished_at = time.time()
             listeners = list(self._listeners)
         for fn in listeners:  # outside the lock (reference: StateMachine.java)
             fn(new_state)
         return True
+
+    def phase_seconds(self) -> dict[str, float]:
+        """Wall seconds spent in each visited non-terminal state; an
+        unfinished query's current state accrues up to now."""
+        with self._lock:
+            history = list(self.state_history)
+            end = self.finished_at
+        if end is None:
+            end = time.time()
+        out: dict[str, float] = {}
+        for i, (state, entered) in enumerate(history):
+            if state in TERMINAL:
+                continue
+            left = history[i + 1][1] if i + 1 < len(history) else end
+            out[state] = out.get(state, 0.0) + max(0.0, left - entered)
+        return out
 
     def fail(self, message: str, code: Optional[str] = None) -> None:
         self.error = message

@@ -597,6 +597,10 @@ class Worker:
         fetched_bytes = 0
         fetched_rows = 0
         remote_pages: dict[int, Page] = {}
+        # exchange-wait attribution for the phase ledger: the whole source
+        # loop is dominated by long-polling producers' buffers (the decode
+        # riding along is noise next to the waits)
+        t_fetch0 = _time.perf_counter()
         for fid_str, src in req.get("sources", {}).items():
             fid = int(fid_str)
             kind = src["kind"]
@@ -629,6 +633,7 @@ class Worker:
             remote_pages[fid] = wire_to_page(blobs, types)
             fetched_rows += _page_rows(remote_pages[fid])
             task.progress()  # each fetched source is a watchdog beat
+        exchange_wait_ms = (_time.perf_counter() - t_fetch0) * 1e3
         self._m_fetched_bytes.inc(fetched_bytes)
 
         # dynamic filtering: fetched build-side key domains narrow the
@@ -640,6 +645,7 @@ class Worker:
 
         out_kind = req["output_kind"]
         out_parts = req["out_parts"]
+        spill_ms = 0.0
         revoked = task.revoke_requested and not req.get("analyze")
         if req.get("analyze"):
             # distributed EXPLAIN ANALYZE: the eager node-hook pass adds
@@ -655,9 +661,11 @@ class Worker:
             # execution so the instantaneous working set matches the
             # shrunken reservation (exec/spill.py's time-multiplexed idiom)
             page = None
+            t_spill0 = _time.perf_counter()
             buffers, rows_out, operators = self._execute_sliced(
                 executor, fragment, remote_pages, req, task
             )
+            spill_ms = (_time.perf_counter() - t_spill0) * 1e3
         else:
             page = executor.execute(fragment, remote_pages)
             operators = executor.last_operator_stats
@@ -688,6 +696,22 @@ class Worker:
             "memory_reserved_bytes": reserve_bytes,
             "memory_blocked_ms": round(mem_blocked_ms, 3),
             "memory_revoked": bool(revoked),
+            # phase-ledger attribution (coordinator sums these across
+            # tasks): compile wall covers every jit signature this task
+            # built (all slices under revocation), execute wall is the
+            # post-compile dispatch of the last run
+            "compile_ms": round(
+                sum(
+                    ev.get("compile_s", 0.0)
+                    for ev in getattr(executor, "compile_events", [])
+                )
+                * 1e3,
+                3,
+            ),
+            "execute_ms": round(getattr(executor, "last_execute_ms", 0.0), 3),
+            "exchange_wait_ms": round(exchange_wait_ms, 3),
+            "spill_ms": round(spill_ms, 3),
+            "compile_events": list(getattr(executor, "compile_events", [])),
         }
 
         if task.canceled:

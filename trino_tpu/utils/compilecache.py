@@ -55,12 +55,17 @@ def cache_stats(repo_root: str | None = None) -> dict:
     d = jax_cache_dir(repo_root)
     entries = 0
     size = 0
+    # newer jax shards entries into nested subdirectories; a top-level
+    # listdir under-reports the footprint (and blinds the profiler's
+    # hit/miss inference, which watches the entry-count delta per compile)
     try:
-        for name in os.listdir(d):
-            p = os.path.join(d, name)
-            if os.path.isfile(p):
-                entries += 1
-                size += os.path.getsize(p)
+        for root, _dirs, files in os.walk(d):
+            for name in files:
+                try:
+                    size += os.path.getsize(os.path.join(root, name))
+                    entries += 1
+                except OSError:
+                    pass  # entry evicted mid-walk
     except OSError:
         pass
     return {"dir": d, "entries": entries, "bytes": size}

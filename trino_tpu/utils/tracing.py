@@ -32,8 +32,22 @@ __all__ = [
     "traceparent", "parse_traceparent", "add_exporters_from_env",
 ]
 
-_ids = random.Random()  # module-level: cheap, fork-safe enough for ids
+_ids = random.Random()  # module-level; reseeded after fork (below)
 _ids_lock = threading.Lock()
+
+
+def _reseed_ids() -> None:
+    """Forked children inherit the parent's RNG state byte-for-byte, so two
+    workers forked from one warm parent would mint IDENTICAL trace/span ids
+    and trace_dump.py would stitch unrelated queries together.  Reseed from
+    the kernel CSPRNG (plus the pid, in case urandom is exhausted) in every
+    child."""
+    with _ids_lock:
+        _ids.seed(int.from_bytes(os.urandom(16), "big") ^ os.getpid())
+
+
+if hasattr(os, "register_at_fork"):  # absent on some non-POSIX platforms
+    os.register_at_fork(after_in_child=_reseed_ids)
 
 
 def _new_trace_id() -> str:
