@@ -11,6 +11,9 @@
 #   corrupt page-frame corruption mid-fetch — crc32 detect + token re-fetch
 #   oom     MEMORY_PRESSURE pool shrink / blocked-on-memory / low-memory
 #           killer / revocation-driven spill scenarios
+# Compile-plane chaos (tests/test_compile_resilience.py):
+#   compile COMPILE_SLOW / COMPILE_FAIL on cluster tasks — queries must
+#           succeed via fallback, breaker stops churn, no hangs
 # No subcommand runs the full seeded chaos schedule suite (-m chaos).
 #
 # Not part of the tier-1 gate (marked slow); run it before touching the
@@ -39,6 +42,11 @@ case "${1:-}" in
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_memory_governance.py -q \
         -k "memory_pressure or killer or blocked or revocation" \
         -p no:cacheprovider "$@"
+    ;;
+  compile)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_compile_resilience.py -q \
+        -k "chaos" -p no:cacheprovider "$@"
     ;;
   *)
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \

@@ -150,6 +150,23 @@ class LocalExecutor:
         self.compile_events: list[dict] = []
         self.last_compile_ms = 0.0
         self.last_execute_ms = 0.0
+        # compile resilience plane (exec/compilesvc.py): bound how long a
+        # query blocks on XLA compile.  budget 0 == wait for the compile
+        # (bounded only by the deadline); deadline 0 == no deadline.  When
+        # the budget expires first the query runs the eager fallback path
+        # and the compiled program swaps in on the next execution.
+        self.compile_wait_budget_ms = 0
+        self.compile_deadline_s = 0.0
+        self.compile_service = None  # None == process-global SERVICE
+        # worker tasks wire their FaultInjector + task id so COMPILE_SLOW /
+        # COMPILE_FAIL faults fire inside this executor's build jobs
+        self.fault_injector = None
+        self.fault_task_id = "local"
+        # fallback attribution: every fallback execution appends
+        # {signature, reason, wait_ms} here (mirrored into compile_events
+        # so the worker->coordinator stats pipeline carries it for free)
+        self.fallback_events: list[dict] = []
+        self.last_fallback_reason: Optional[str] = None
 
     # ------------------------------------------------------------- table IO
     def table_page(
@@ -323,6 +340,13 @@ class LocalExecutor:
                         break
                     for nid, req in overflow.items():
                         caps[nid] = _pow2(max(req, caps[nid] * 2))
+        # capacity bucketing (ROADMAP 2a): every cap — planner-fed, stats-
+        # fed, cached from an older code version, or learned — lands on a
+        # pow2 tier, so near-identical shapes collapse onto ONE jit
+        # signature instead of each minting its own compiled program.  Also
+        # snapshots the dict: the retry loop below mutates caps in place,
+        # and learned/cached dicts must not alias it.
+        caps = {nid: _pow2(max(int(c), 1)) for nid, c in caps.items()}
         budget = self.memory_budget_bytes
         if budget:
             est = self._estimate_bytes(inputs, caps)
@@ -413,10 +437,20 @@ class LocalExecutor:
                     n.catalog, n.table, n.column_names, n.output_types, scan_id=i
                 )
         caps = self._learned_caps[plan]
-        cache_key = (plan, self.collect_operator_stats,
-                     tuple(sorted(caps.items())),
-                     tuple(sorted((k, p.capacity) for k, p in inputs.items())))
-        fn, _holder, _sig = self._jit_cache[cache_key]
+        cache_key, _treedef, _avals = self._cache_key(plan, inputs, caps)
+        entry = self._jit_cache.get(cache_key)
+        if entry is None:
+            # every prior execution fell back (compile never swapped in):
+            # force a synchronous compile — steady-state measures the
+            # compiled program, not the eager path
+            saved = self.compile_wait_budget_ms
+            self.compile_wait_budget_ms = 0
+            try:
+                self._run(plan, inputs, caps)
+            finally:
+                self.compile_wait_budget_ms = saved
+            entry = self._jit_cache[cache_key]
+        fn, _holder, _sig = entry
         out, packed = fn(inputs)
         jax.block_until_ready(packed)  # drain any pending work
         # keeping many dispatches in flight also keeps every run's OUTPUT
@@ -592,84 +626,169 @@ class LocalExecutor:
                 stats.setdefault(key - _STATS_ROWS_BASE, {})["rows"] = int(val)
         return page, stats
 
-    def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
-        import time as _time
-
-        from ..utils.profiler import PROFILER, cost_summary, signature_of
-
-        collect = self.collect_operator_stats
-        # the AOT-compiled entry is pinned to one input pytree + avals
-        # (unlike a lazy jit, which retraces transparently), so the key
-        # must carry the full abstract structure: a None column where a
-        # leaf used to be, or a reshaped dictionary, is a NEW program
+    def _cache_key(self, plan: PlanNode, inputs: dict[str, Page], caps):
+        """(jit-cache key, treedef, avals) for one (plan, inputs, caps).
+        The AOT-compiled entry is pinned to one input pytree + avals
+        (unlike a lazy jit, which retraces transparently), so the key
+        must carry the full abstract structure: a None column where a
+        leaf used to be, or a reshaped dictionary, is a NEW program."""
         leaves, treedef = jax.tree_util.tree_flatten(inputs)
         avals = tuple(
             (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x).__name__)))
             for x in leaves
         )
-        cache_key = (plan, collect, tuple(sorted(caps.items())),
-                     tuple(sorted((k, p.capacity) for k, p in inputs.items())),
-                     treedef, avals)
+        key = (plan, self.collect_operator_stats, tuple(sorted(caps.items())),
+               tuple(sorted((k, p.capacity) for k, p in inputs.items())),
+               treedef, avals)
+        return key, treedef, avals
+
+    def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
+        import time as _time
+
+        from ..utils.profiler import PROFILER, cost_summary, signature_of
+        from .compilesvc import FALLBACKS, SERVICE
+
+        collect = self.collect_operator_stats
+        cache_key, treedef, avals = self._cache_key(plan, inputs, caps)
         _JIT_CACHE_LOOKUPS.labels(
             "hit" if cache_key in self._jit_cache else "miss"
         ).inc()
         if cache_key not in self._jit_cache:
-            # pack every overflow counter into ONE int64 vector inside the
-            # jit: on a tunneled TPU each device->host transfer is a full
-            # network round-trip, and fetching a dict of scalars one RPC at a
-            # time dominated query latency (~8x the kernel time).  The key
-            # order is recorded at trace time (deterministic per cache entry).
-            holder: dict = {"keys": None}
-
-            def call(pages, _holder=holder):
-                out_page, req = _trace_plan(plan, pages, caps, collect_stats=collect)
-                keys = sorted(req, key=repr)
-                _holder["keys"] = keys
-                packed = (
-                    jnp.stack([jnp.asarray(req[k], jnp.int64) for k in keys])
-                    if keys
-                    else jnp.zeros((0,), jnp.int64)
-                )
-                return out_page, packed
-
-            # AOT lower+compile (instead of letting the first dispatch
-            # compile lazily) so compile wall is measured apart from execute
-            # wall and the backend's cost_analysis() is capturable.  A
-            # capacity-overflow retry lands here again with new caps — a new
-            # SIGNATURE — so a warm-run recompile regression (q03, BENCH_r05)
-            # is attributable to the tier that recompiled, by name.
+            # A capacity-overflow retry lands here again with new caps — a
+            # new SIGNATURE — so a warm-run recompile regression (q03,
+            # BENCH_r05) is attributable to the tier that recompiled.
             sig = signature_of(plan, caps)
-            entries_before = _pcache_entries()
-            jitted = jax.jit(call)
-            t0 = _time.perf_counter()
-            cost = None
-            try:
-                fn = jitted.lower(inputs).compile()
-                cost = cost_summary(fn)
-            except Exception:
-                # AOT unsupported for this program/backend: fall back to the
-                # lazy jit; its first dispatch below folds compile into
-                # execute wall (attribution degrades, results don't)
-                fn = jitted
-            compile_s = _time.perf_counter() - t0
-            self.last_compile_ms += compile_s * 1e3
-            cache_result = _pcache_result(entries_before, compile_s)
-            PROFILER.record_compile(sig, compile_s, cache_result, cost)
-            event = {
-                "signature": sig, "compile_s": round(compile_s, 4),
-                "cache": cache_result,
-            }
-            if cost:
-                event.update(cost)
-            self.compile_events.append(event)
-            self._jit_cache[cache_key] = (fn, holder, sig)
+            svc = self.compile_service or SERVICE
+            # snapshot caps for the traced closure: execute()'s overflow
+            # retry loop mutates its dict in place, and a compile still
+            # queued in the service after a fallback must trace the tiers
+            # its signature was named for
+            call, holder = _make_call(plan, dict(caps), collect)
+
+            def build(_call=call, _holder=holder):
+                # AOT lower+compile (instead of letting the first dispatch
+                # compile lazily) so compile wall is measured apart from
+                # execute wall and cost_analysis() is capturable
+                entries_before = _pcache_entries()
+                jitted = jax.jit(_call)
+                t0 = _time.perf_counter()
+                cost = None
+                try:
+                    fn = jitted.lower(inputs).compile()
+                    cost = cost_summary(fn)
+                except Exception:
+                    # AOT unsupported for this program/backend: fall back
+                    # to the lazy jit; its first dispatch folds compile
+                    # into execute wall (attribution degrades, results
+                    # don't)
+                    fn = jitted
+                compile_s = _time.perf_counter() - t0
+                cache_result = _pcache_result(entries_before, compile_s)
+                PROFILER.record_compile(sig, compile_s, cache_result, cost)
+                return {"fn": fn, "holder": _holder, "sig": sig,
+                        "compile_s": compile_s, "cache": cache_result,
+                        "cost": cost}
+
+            # the service key spans executors: (signature, stats mode,
+            # pytree structure, avals).  The treedef hashes trace-time
+            # Dictionary objects BY IDENTITY (data/page.py), so a shared
+            # program can never decode strings through another input's
+            # dictionary.
+            budget_ms = int(self.compile_wait_budget_ms or 0)
+            out = svc.obtain(
+                (sig, collect, treedef, avals), sig, build,
+                wait_budget_s=(budget_ms / 1e3) if budget_ms > 0 else None,
+                deadline_s=float(self.compile_deadline_s or 0.0),
+                injector=self.fault_injector,
+                fault_task_id=self.fault_task_id,
+            )
+            wait_ms = round(out.waited_s * 1e3, 3)
+            self.last_compile_ms += wait_ms
+            if out.status == "ready":
+                res = out.result
+                self._jit_cache[cache_key] = (res["fn"], res["holder"], sig)
+                if out.fresh:
+                    event = {
+                        "signature": sig,
+                        "compile_s": round(res["compile_s"], 4),
+                        "cache": res["cache"],
+                        "mode": "async" if budget_ms > 0 else "sync",
+                    }
+                    if res["cost"]:
+                        event.update(res["cost"])
+                else:
+                    # joined an in-flight compile or swapped in a program
+                    # another execution finished in the background: the
+                    # compile wall belongs to the owner, only the wait here
+                    event = {"signature": sig, "mode": "async",
+                             "wait_ms": wait_ms}
+                self.compile_events.append(event)
+            else:
+                # fallback: budget exhausted / deadline / compile failure /
+                # poisoned signature.  Execute the eager uncompiled trace
+                # (op-by-op dispatch, the same path host-agg plans use) —
+                # bounded-latency degradation instead of a compile wall.
+                reason = out.reason or "compile_wait"
+                FALLBACKS.labels(reason).inc()
+                PROFILER.record_fallback(sig, reason)
+                self.last_fallback_reason = reason
+                event = {"signature": sig, "mode": "fallback",
+                         "reason": reason, "wait_ms": wait_ms}
+                if out.status == "timeout":
+                    event["error"] = "COMPILE_TIMEOUT"
+                self.compile_events.append(event)
+                self.fallback_events.append(dict(event))
+                t0 = _time.perf_counter()
+                out_page, required = _trace_plan(
+                    plan, inputs, dict(caps), collect_stats=collect
+                )
+                PROFILER.record_execute(
+                    sig, _time.perf_counter() - t0, fallback=True
+                )
+                return out_page, {k: int(v) for k, v in required.items()}
         fn, holder, sig = self._jit_cache[cache_key]
         t0 = _time.perf_counter()
-        out_page, packed = fn(inputs)
+        try:
+            out_page, packed = fn(inputs)
+        except TypeError:
+            # AOT programs are pinned to one input pytree structure; a
+            # structure drift the key missed (e.g. weak-type promotion)
+            # must not fail the query — retrace with a lazy jit, counted
+            # as a cache miss.  A genuine TypeError in the traced ops
+            # re-raises from the lazy dispatch.
+            _JIT_CACHE_LOOKUPS.labels("miss").inc()
+            call, holder = _make_call(plan, dict(caps), collect)
+            fn = jax.jit(call)
+            self._jit_cache[cache_key] = (fn, holder, sig)
+            out_page, packed = fn(inputs)
         vals = np.asarray(packed)  # ONE device->host transfer
         PROFILER.record_execute(sig, _time.perf_counter() - t0)
         required = dict(zip(holder["keys"], vals.tolist()))
         return out_page, required
+
+
+def _make_call(plan: PlanNode, caps: dict[int, int], collect: bool):
+    """Build the traced entry point for one (plan, caps, stats-mode).
+
+    Packs every overflow counter into ONE int64 vector inside the jit: on
+    a tunneled TPU each device->host transfer is a full network round-trip,
+    and fetching a dict of scalars one RPC at a time dominated query
+    latency (~8x the kernel time).  The key order is recorded at trace
+    time in `holder` (deterministic per cache entry)."""
+    holder: dict = {"keys": None}
+
+    def call(pages, _holder=holder):
+        out_page, req = _trace_plan(plan, pages, caps, collect_stats=collect)
+        keys = sorted(req, key=repr)
+        _holder["keys"] = keys
+        packed = (
+            jnp.stack([jnp.asarray(req[k], jnp.int64) for k in keys])
+            if keys
+            else jnp.zeros((0,), jnp.int64)
+        )
+        return out_page, packed
+
+    return call, holder
 
 
 def _pcache_entries() -> Optional[int]:

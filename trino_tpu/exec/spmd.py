@@ -110,6 +110,11 @@ class SpmdExecutor(LocalExecutor):
                         break
                     for nid, req in overflow.items():
                         caps[nid] = _pow2(max(req, caps[nid] * 2))
+        # capacity bucketing (ROADMAP 2a), same as LocalExecutor.execute:
+        # quantize every fed capacity onto a pow2 tier so near-identical
+        # shapes share one SPMD program; also un-aliases the learned dict
+        # from the retry loop's in-place growth below
+        caps = {nid: _pow2(max(int(c), 1)) for nid, c in caps.items()}
         for _ in range(14):
             out_page, required = self._run_spmd(plan, inputs, caps)
             for key, val in required.items():

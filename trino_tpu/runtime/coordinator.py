@@ -197,6 +197,32 @@ class Coordinator:
     def start(self) -> "Coordinator":
         for t in self._threads:
             t.start()
+        # startup cache warming (runtime/warmup.py): replay the top-K
+        # recurring FINISHED statements from the persisted history so their
+        # XLA programs are compiled before the first client query hits the
+        # compile cliff; daemon thread — the server accepts queries while
+        # it warms
+        try:
+            warm_k = int(os.environ.get("TRINO_TPU_WARM_SIGNATURES") or 0)
+        except ValueError:
+            warm_k = 0
+        if warm_k > 0 and len(self.history):
+            from .warmup import warm_from_history
+
+            def _warm():
+                # workers announce after the coordinator is up; replaying
+                # into an empty cluster would just record failures
+                deadline = time.monotonic() + 120.0
+                while not self._hb_stop.is_set():
+                    if self.alive_workers() or time.monotonic() > deadline:
+                        break
+                    time.sleep(0.2)
+                if self.alive_workers():
+                    warm_from_history(self.execute_query, self.history, warm_k)
+
+            threading.Thread(
+                target=_warm, daemon=True, name="compile-warmer"
+            ).start()
         return self
 
     def add_event_listener(self, listener) -> None:
@@ -640,6 +666,10 @@ class Coordinator:
             "blocked_on_memory_ms": round(
                 float(qi.get("memory_blocked_ms") or 0.0), 3
             ),
+            # compile resilience: how many task executions ran the eager
+            # fallback path instead of a compiled program (a count, not a
+            # duration — their wall is inside executing_ms)
+            "fallback_executions": int(qi.get("fallback_executions") or 0),
         }
         return ledger
 
@@ -884,6 +914,14 @@ class Coordinator:
                 "memory_blocked_timeout_s": float(
                     self.session.get("memory_blocked_timeout_s") or 0.0
                 ),
+                # compile resilience plane: bound how long each task may
+                # block on XLA compile before running its fallback path
+                "compile_wait_budget_ms": int(
+                    self.session.get("compile_wait_budget_ms") or 0
+                ),
+                "compile_deadline_s": float(
+                    self.session.get("compile_deadline_s") or 0.0
+                ),
             }
             tag = f"{sm.query_id}_a{attempt}_f{f.id}"
             frag_meta[f.id] = (payload_base, tag)
@@ -985,6 +1023,15 @@ class Coordinator:
             executor = LocalExecutor(self.catalogs, self.default_catalog)
             # the root stage reports operator stats like any worker task
             executor.collect_operator_stats = True
+            # ... and honors the same compile-resilience knobs: a compile
+            # storm on the workers can queue the root fragment's build
+            # behind theirs, and the root must fall back, not wall
+            executor.compile_wait_budget_ms = int(
+                self.session.get("compile_wait_budget_ms") or 0
+            )
+            executor.compile_deadline_s = float(
+                self.session.get("compile_deadline_s") or 0.0
+            )
             if record.get("cancel"):  # e.g. memory kill during the stages
                 raise RuntimeError(
                     record.get("kill_reason") or "Query was canceled"
@@ -1068,17 +1115,38 @@ class Coordinator:
         exchange_wait_ms = 0.0
         spill_ms = 0.0
         # named jit signatures merged across every task (utils/profiler.py):
-        # sig -> {compiles, compile_s, cache: {hit, miss, uncached}}
+        # sig -> {compiles, compile_s, cache, modes, fallbacks, timeouts}
         compile_sigs: dict[str, dict] = {}
+        fallback_execs = 0
+        fallback_reasons: dict[str, int] = {}
 
         def merge_compile_events(events) -> None:
+            nonlocal fallback_execs
             for ev in events or []:
                 sig = ev.get("signature") or "?"
                 agg = compile_sigs.setdefault(
                     sig,
                     {"compiles": 0, "compile_s": 0.0,
-                     "cache": {"hit": 0, "miss": 0, "uncached": 0}},
+                     "cache": {"hit": 0, "miss": 0, "uncached": 0},
+                     "modes": {}, "fallbacks": {}, "timeouts": 0},
                 )
+                mode = ev.get("mode") or "sync"
+                agg["modes"][mode] = agg["modes"].get(mode, 0) + 1
+                if mode == "fallback":
+                    # fallback execution, not a compile: attribute apart
+                    reason = ev.get("reason") or "compile_wait"
+                    agg["fallbacks"][reason] = (
+                        agg["fallbacks"].get(reason, 0) + 1
+                    )
+                    fallback_execs += 1
+                    fallback_reasons[reason] = (
+                        fallback_reasons.get(reason, 0) + 1
+                    )
+                    if ev.get("error") == "COMPILE_TIMEOUT":
+                        agg["timeouts"] += 1
+                    continue
+                if ev.get("compile_s") is None:
+                    continue  # joined/swapped-in: the owner's event counts
                 agg["compiles"] += 1
                 agg["compile_s"] = round(
                     agg["compile_s"] + float(ev.get("compile_s") or 0.0), 4
@@ -1124,6 +1192,7 @@ class Coordinator:
                         "rows_pruned": st.get("rows_pruned"),
                         "compile_ms": st.get("compile_ms"),
                         "exchange_wait_ms": st.get("exchange_wait_ms"),
+                        "fallback": bool(st.get("fallback")),
                     }
                     task_infos.append(ti)
                     cpu_ms += float(st.get("wall_ms") or 0.0)
@@ -1184,6 +1253,8 @@ class Coordinator:
             "exchange_wait_ms": round(exchange_wait_ms, 3),
             "spill_ms": round(spill_ms, 3),
             "compile_signatures": compile_sigs,
+            "fallback_executions": fallback_execs,
+            "fallback_reasons": fallback_reasons,
             "wall_ms": round((time.perf_counter() - t_query0) * 1e3, 3),
             "output_rows": len(record["result"] or []),
             "task_retries": record.get("task_retries", 0),

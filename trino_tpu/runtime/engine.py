@@ -192,7 +192,20 @@ class Engine:
         self.last_spill = ooc  # observable: spilled_bytes/spill_files
         return ooc.execute(plan)
 
+    def _apply_compile_props(self) -> None:
+        """Session → executor compile-resilience knobs (exec/compilesvc.py):
+        re-applied per statement so SET SESSION takes effect immediately."""
+        for ex in (self.executor, getattr(self, "_local_fallback", None)):
+            if ex is not None and hasattr(ex, "compile_wait_budget_ms"):
+                ex.compile_wait_budget_ms = int(
+                    self.session.get("compile_wait_budget_ms") or 0
+                )
+                ex.compile_deadline_s = float(
+                    self.session.get("compile_deadline_s") or 0.0
+                )
+
     def _execute_planned(self, plan) -> Page:
+        self._apply_compile_props()
         if self.distributed:
             from ..exec.compiler import _has_host_aggs
 
@@ -246,6 +259,15 @@ class Engine:
             )
         )
         return rows
+
+    def warm_from_history(self, history, limit: int = 8) -> int:
+        """Replay the top-``limit`` recurring FINISHED statements from a
+        QueryHistoryStore so their XLA programs land in the jit + persistent
+        caches before the first client query (runtime/warmup.py); returns
+        how many statements warmed successfully."""
+        from .warmup import warm_from_history as _warm
+
+        return _warm(self.query, history, limit)
 
     def _query_columns(self, query) -> tuple[list, list, list]:
         """(names, types, host column arrays) of a query result — the write
@@ -526,6 +548,7 @@ class Engine:
             # compile ledger stood so the footer shows only THIS
             # statement's jit signatures
             n_ev0 = len(getattr(ex, "compile_events", []) or [])
+            self._apply_compile_props()
             page, stats = ex.explain_analyze(plan)
             wall = _time.perf_counter() - t0
             if fmt == "json":
@@ -581,6 +604,23 @@ class Engine:
             f"-- phases: compile {compile_ms:.1f} ms, execute {execute_ms:.1f} ms"
         ]
         for ev in events:
+            if ev.get("mode") == "fallback":
+                # compile didn't finish inside the wait budget / deadline:
+                # the statement ran eager (exec/compilesvc.py)
+                out.append(
+                    f"-- compile: {ev.get('signature', '?')} fallback "
+                    f"({ev.get('reason', '?')}, waited "
+                    f"{ev.get('wait_ms', 0.0):.1f} ms)"
+                )
+                continue
+            if ev.get("compile_s") is None:
+                # async join / swap-in: another query (or an earlier
+                # fallback execution) owns the actual compile wall
+                out.append(
+                    f"-- compile: {ev.get('signature', '?')} async "
+                    f"(joined after {ev.get('wait_ms', 0.0):.1f} ms)"
+                )
+                continue
             out.append(
                 f"-- compile: {ev.get('signature', '?')} "
                 f"{ev.get('compile_s', 0.0) * 1e3:.1f} ms "
@@ -643,7 +683,11 @@ class Engine:
             text.append(
                 "-- phases: "
                 + ", ".join(
-                    f"{k[: -len('_ms')]} {v:.1f} ms"
+                    (
+                        f"{k[: -len('_ms')]} {v:.1f} ms"
+                        if k.endswith("_ms")
+                        else f"{k} {v}"  # plain counts (fallback_executions)
+                    )
                     for k, v in ledger.items()
                     if isinstance(v, (int, float))
                 )
@@ -655,10 +699,26 @@ class Engine:
             cache_txt = ", ".join(
                 f"{k}: {v}" for k, v in sorted(cache.items()) if v
             )
+            # compile-resilience disposition: async | fallback | timeout
+            # (exec/compilesvc.py) — which path executions of this
+            # signature actually took while the program was (or wasn't)
+            # being built
+            flags = []
+            if s.get("timeouts"):
+                flags.append(f"timeout x{s['timeouts']}")
+            fb = s.get("fallbacks") or {}
+            if fb:
+                flags.append(
+                    "fallback "
+                    + ", ".join(f"{r}: {c}" for r, c in sorted(fb.items()))
+                )
+            if (s.get("modes") or {}).get("async"):
+                flags.append("async")
             text.append(
                 f"-- compile: {sig} x{s.get('compiles', 0)} "
                 f"{s.get('compile_s', 0.0) * 1e3:.1f} ms"
                 + (f" [persistent cache: {cache_txt}]" if cache_txt else "")
+                + (f" [{'; '.join(flags)}]" if flags else "")
             )
         return text
 

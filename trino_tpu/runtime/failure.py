@@ -285,7 +285,7 @@ class FailureDetector:
 @dataclass
 class _FaultRule:
     task_id: str  # "*" == any; otherwise exact id or prefix
-    mode: str  # ERROR | TIMEOUT | SLOW | EXCHANGE_DROP
+    mode: str  # one of FaultInjector.MODES
     delay_ms: int = 0
     count: int = 1  # firings remaining; <= 0 after exhaustion
     probability: float = 1.0
@@ -303,6 +303,10 @@ class FaultInjector:
       - task_fault(task_id): ERROR raises immediately, TIMEOUT sleeps
         then raises (a slow failure that exercises status-deadline
         escalation), SLOW sleeps then lets the task run normally.
+      - compile_fault(task_id): COMPILE_SLOW sleeps inside the compile
+        service's build job (the query must complete via fallback within
+        its wait budget), COMPILE_FAIL raises there (the per-signature
+        circuit breaker must absorb the churn).
       - drop_fetch(task_id): EXCHANGE_DROP answers the next `count`
         matching page-fetch requests with HTTP 503 — the consumer's
         Backoff retries and resumes from its token, so recovery must be
@@ -322,7 +326,7 @@ class FaultInjector:
 
     MODES = (
         "ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP", "CORRUPT",
-        "MEMORY_PRESSURE",
+        "MEMORY_PRESSURE", "COMPILE_SLOW", "COMPILE_FAIL",
     )
 
     def __init__(self):
@@ -388,6 +392,22 @@ class FaultInjector:
     def drop_fetch(self, task_id: str) -> bool:
         """True == answer this page-fetch request with a transient 503."""
         return self._take(task_id, ("EXCHANGE_DROP",)) is not None
+
+    def compile_fault(
+        self, task_id: str, sleep: Callable[[float], None] = time.sleep
+    ) -> None:
+        """Apply any armed COMPILE_SLOW / COMPILE_FAIL fault.  Runs inside
+        the compile service's build job (exec/compilesvc.py), so SLOW
+        exercises the wait-budget fallback and deadline paths while FAIL
+        exercises the per-signature circuit breaker — the query itself
+        must survive either via fallback execution."""
+        rule = self._take(task_id, ("COMPILE_SLOW", "COMPILE_FAIL"))
+        if rule is None:
+            return
+        if rule.mode == "COMPILE_FAIL":
+            raise RuntimeError(f"injected compile failure for task {task_id}")
+        if rule.delay_ms:
+            sleep(rule.delay_ms / 1000.0)
 
     def corrupt_fetch(self, task_id: str) -> bool:
         """True == flip a byte in the exchange frame served for this

@@ -181,6 +181,23 @@ PROPERTIES: dict[str, _Prop] = {
             None,
         ),
         _Prop(
+            "compile_wait_budget_ms", int, 0,
+            "how long a query blocks on the background compile service "
+            "for a fragment's XLA program before executing via the eager "
+            "fallback path (exec/compilesvc.py; the compiled program "
+            "swaps in for later executions of the signature); 0 = wait "
+            "for the compile, bounded only by compile_deadline_s",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "compile_deadline_s", float, 300.0,
+            "hard per-signature compile deadline: a compile still running "
+            "past this records a typed COMPILE_TIMEOUT ledger entry, "
+            "feeds the signature's circuit breaker, and the query "
+            "proceeds via fallback — never a hung query; 0 disables",
+            lambda v: v >= 0,
+        ),
+        _Prop(
             "query_max_memory_bytes", int, 0,
             "device-memory budget per query; 0 = auto (~80% of the "
             "accelerator's reported HBM), -1 = unlimited (never reroute). "

@@ -593,6 +593,15 @@ class Worker:
         executor.collect_operator_stats = True
         if req.get("memory_budget_bytes"):
             executor.memory_budget_bytes = int(req["memory_budget_bytes"])
+        # compile resilience plane: the session's wait budget / deadline
+        # ride the task payload, and the worker's fault matrix reaches
+        # into the compile service's build jobs (COMPILE_SLOW/FAIL)
+        executor.compile_wait_budget_ms = int(
+            req.get("compile_wait_budget_ms") or 0
+        )
+        executor.compile_deadline_s = float(req.get("compile_deadline_s") or 0.0)
+        executor.fault_injector = self.fault_injector
+        executor.fault_task_id = task.task_id
 
         fetched_bytes = 0
         fetched_rows = 0
@@ -630,7 +639,11 @@ class Worker:
 
             fetched_bytes += sum(len(b) for b in blobs)
             types = [parse_type(t) for t in src["types"]]
-            remote_pages[fid] = wire_to_page(blobs, types)
+            # pad exchange pages to pow2 capacity (dead-row live mask —
+            # the spill executor's idiom): otherwise every distinct
+            # producer row count mints its own input shape class and jit
+            # signature (ROADMAP 2a's shape-class explosion)
+            remote_pages[fid] = wire_to_page(blobs, types, pad_pow2=True)
             fetched_rows += _page_rows(remote_pages[fid])
             task.progress()  # each fetched source is a watchdog beat
         exchange_wait_ms = (_time.perf_counter() - t_fetch0) * 1e3
@@ -702,16 +715,28 @@ class Worker:
             # post-compile dispatch of the last run
             "compile_ms": round(
                 sum(
-                    ev.get("compile_s", 0.0)
+                    # classic/fresh events carry the compile wall; joined
+                    # and fallback events carry only the wall THIS task
+                    # spent waiting on the service
+                    ev["compile_s"] * 1e3 if ev.get("compile_s") is not None
+                    else float(ev.get("wait_ms") or 0.0)
                     for ev in getattr(executor, "compile_events", [])
-                )
-                * 1e3,
+                ),
                 3,
             ),
             "execute_ms": round(getattr(executor, "last_execute_ms", 0.0), 3),
             "exchange_wait_ms": round(exchange_wait_ms, 3),
             "spill_ms": round(spill_ms, 3),
             "compile_events": list(getattr(executor, "compile_events", [])),
+            # fallback phase attribution (compile resilience plane): the
+            # coordinator folds these into QueryInfo and the phase ledger
+            "fallback": bool(getattr(executor, "fallback_events", None)),
+            "fallback_executions": len(
+                getattr(executor, "fallback_events", []) or []
+            ),
+            "fallback_reasons": _count_reasons(
+                getattr(executor, "fallback_events", []) or []
+            ),
         }
 
         if task.canceled:
@@ -927,6 +952,15 @@ class Worker:
                             except OSError:
                                 pass
                 task.buffers = {}
+
+
+def _count_reasons(fallback_events: list) -> dict[str, int]:
+    """reason -> count over an executor's fallback ledger (task stats)."""
+    out: dict[str, int] = {}
+    for ev in fallback_events:
+        r = ev.get("reason") or "compile_wait"
+        out[r] = out.get(r, 0) + 1
+    return out
 
 
 def _page_rows(page: Page) -> int:

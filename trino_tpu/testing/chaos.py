@@ -19,7 +19,9 @@ from typing import Optional, Sequence
 
 from .runner import DistributedQueryRunner
 
-__all__ = ["ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES"]
+__all__ = [
+    "ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES", "COMPILE_MODES",
+]
 
 # modes that a retry_policy=TASK cluster must absorb without losing the
 # query: ERROR/TIMEOUT fail the task (re-scheduled on another worker),
@@ -33,6 +35,15 @@ RECOVERABLE_MODES = ("ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP")
 # existing seeded schedules replay identically; pass
 # modes=CORRUPTION_MODES (or RECOVERABLE_MODES + ("CORRUPT",)) to arm it.
 CORRUPTION_MODES = RECOVERABLE_MODES + ("CORRUPT",)
+
+# opt-in: compile-plane chaos (exec/compilesvc.py).  COMPILE_SLOW stalls a
+# task's XLA build by delay_ms (the query must fall back / absorb the
+# wait), COMPILE_FAIL raises inside the build (the query must succeed via
+# fallback and the signature breaker must stop the churn).  A separate
+# tuple — not folded into RECOVERABLE_MODES — so existing seeded schedules
+# replay identically; pass modes=COMPILE_MODES (or RECOVERABLE_MODES +
+# COMPILE_MODES) to arm it.
+COMPILE_MODES = ("COMPILE_SLOW", "COMPILE_FAIL")
 
 
 class ChaosRunner:
@@ -73,7 +84,7 @@ class ChaosRunner:
                 "task_id": "*",
                 "delay_ms": (
                     self.rng.choice((50, 150, 300))
-                    if mode in ("TIMEOUT", "SLOW")
+                    if mode in ("TIMEOUT", "SLOW", "COMPILE_SLOW")
                     else 0
                 ),
                 "count": self.rng.randint(1, 3) if mode == "EXCHANGE_DROP" else 1,

@@ -129,6 +129,12 @@ class CompileProfiler:
                 "executes": 0, "execute_s": 0.0,
                 "cache": {"hit": 0, "miss": 0, "uncached": 0},
                 "flops": None, "bytes_accessed": None,
+                # compile resilience plane (exec/compilesvc.py): fallback
+                # executions attributed apart from compiled ones, so the
+                # perf gate can tell "slow because degraded" from "slow
+                # because regressed"
+                "fallbacks": {}, "fallback_executes": 0,
+                "fallback_execute_s": 0.0, "timeouts": 0,
             }
         return e
 
@@ -154,12 +160,37 @@ class CompileProfiler:
                 if cost.get("bytes_accessed") is not None:
                     e["bytes_accessed"] = cost["bytes_accessed"]
 
-    def record_execute(self, sig: str, seconds: float) -> None:
+    def record_execute(
+        self, sig: str, seconds: float, fallback: bool = False
+    ) -> None:
         _EXECUTE_SECONDS.observe(seconds)
         with self._lock:
             e = self._entry(sig)
-            e["executes"] += 1
-            e["execute_s"] += float(seconds)
+            if fallback:
+                e["fallback_executes"] += 1
+                e["fallback_execute_s"] += float(seconds)
+            else:
+                e["executes"] += 1
+                e["execute_s"] += float(seconds)
+
+    def record_fallback(self, sig: str, reason: str) -> None:
+        """A query executed this signature via the eager fallback path
+        instead of a compiled program (reason: compile_wait /
+        compile_timeout / compile_error / breaker_open)."""
+        with self._lock:
+            e = self._entry(sig)
+            e["fallbacks"][reason] = e["fallbacks"].get(reason, 0) + 1
+
+    def record_compile_timeout(self, sig: str) -> None:
+        """A compile for this signature blew past compile_deadline_s."""
+        with self._lock:
+            self._entry(sig)["timeouts"] += 1
+
+    def record_warm(self) -> None:
+        """A startup-warming replay compiled (or re-validated) a
+        signature ahead of traffic; counted on the persistent-cache
+        event surface so restarts' pre-paid compiles are visible."""
+        _PCACHE_EVENTS.labels("warm").inc()
 
     def snapshot(self, sig: Optional[str] = None):
         """Deep copy: one signature's record, or {sig: record} for all."""
@@ -186,6 +217,7 @@ class CompileProfiler:
 def _copy(e: dict) -> dict:
     out = dict(e)
     out["cache"] = dict(e["cache"])
+    out["fallbacks"] = dict(e.get("fallbacks") or {})
     return out
 
 
