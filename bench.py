@@ -35,11 +35,18 @@ covers TWO warm executes: the initial compile and the adaptive-compaction
 tightened-tier recompile) is flagged in `warm_regressions` — a loud signal
 in the recorded bench JSON.
 
+Concurrency (ROADMAP item 3 seed): N protocol clients x M queries each
+against a 2-worker loopback cluster — QPS + p50/p99 latency under load in
+`concurrency`, not just single-query wall.
+
 Env knobs: BENCH_SF (default 1), BENCH_RUNS (default 5),
 BENCH_QUERIES (default q18,q03,q01,q06), BENCH_BUDGET_S (default 420),
 BENCH_TPCDS (default q64,q95 at scale 0.01; empty disables),
 BENCH_SF10_Q3 (default auto: runs if budget headroom remains),
-BENCH_WARM_BOUND (default 240).
+BENCH_WARM_BOUND (default 240),
+BENCH_CONCURRENCY (default 1; 0 disables), BENCH_CONC_CLIENTS (default 4),
+BENCH_CONC_QUERIES (default 5 per client), BENCH_CONC_SF (default 0.01),
+BENCH_CONC_SQL (default lineitem group-by).
 """
 
 import json
@@ -164,6 +171,84 @@ def _measure_tpch_baselines(sf: float, qnames, deadline) -> dict:
     cache[key] = entry
     _save_baseline(cache)
     return entry
+
+
+def _bench_concurrency(deadline) -> dict:
+    """N clients x M queries through the full distributed protocol stack
+    (POST /v1/statement + nextUri polling against a 2-worker loopback
+    cluster): QPS and tail latency under concurrent load.  Small scale
+    factor on purpose — this measures scheduling/protocol throughput, not
+    scan bandwidth (the single-query sections above own that)."""
+    import threading
+
+    from trino_tpu.client import StatementClient
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing import DistributedQueryRunner
+
+    clients = int(os.environ.get("BENCH_CONC_CLIENTS", "4"))
+    per_client = int(os.environ.get("BENCH_CONC_QUERIES", "5"))
+    conc_sf = float(os.environ.get("BENCH_CONC_SF", "0.01"))
+    sql = os.environ.get(
+        "BENCH_CONC_SQL",
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag",
+    )
+    runner = DistributedQueryRunner(num_workers=2, default_catalog="tpch")
+    runner.register_catalog("tpch", TpchConnector(conc_sf))
+    runner.start()
+    try:
+        runner.query(sql)  # warm: compile lands outside the timed window
+        lats: list[float] = []
+        errors = [0]
+        lock = threading.Lock()
+
+        def one_client():
+            c = StatementClient(runner.coordinator.url)
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                try:
+                    c.execute(sql, timeout=120)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                else:
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+
+        threads = [
+            threading.Thread(target=one_client, daemon=True)
+            for _ in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        join_by = time.perf_counter() + max(deadline.remaining(), 30.0)
+        for t in threads:
+            t.join(timeout=max(join_by - time.perf_counter(), 0.1))
+        wall = time.perf_counter() - t0
+        with lock:  # a timed-out straggler may still be appending
+            done = sorted(lats)
+            errs = errors[0]
+
+        def pct(p):
+            if not done:
+                return None
+            return round(done[min(len(done) - 1, int(p * len(done)))] * 1000, 1)
+
+        return {
+            "clients": clients,
+            "queries_per_client": per_client,
+            "sf": conc_sf,
+            "completed": len(done),
+            "errors": errs + sum(1 for t in threads if t.is_alive()),
+            "wall_s": round(wall, 3),
+            "qps": round(len(done) / wall, 2) if wall > 0 else None,
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+        }
+    finally:
+        runner.stop()
 
 
 def main() -> None:
@@ -366,6 +451,14 @@ def main() -> None:
         except Exception as e:
             result["queries"]["q03_sf10"] = {"error": str(e)[:200]}
             emit()
+
+    # ---- concurrency: N clients x M queries (ROADMAP item 3 seed) --------
+    if os.environ.get("BENCH_CONCURRENCY", "1") != "0" and deadline.remaining() > 60:
+        try:
+            result["concurrency"] = _bench_concurrency(deadline)
+        except Exception as e:
+            result["concurrency"] = {"error": str(e)[:200]}
+        emit()
 
     # sqlite baselines LAST (the expendable part of the budget); cached
     # measurements from a prior run make this free

@@ -14,6 +14,11 @@
 # Compile-plane chaos (tests/test_compile_resilience.py):
 #   compile COMPILE_SLOW / COMPILE_FAIL on cluster tasks — queries must
 #           succeed via fallback, breaker stops churn, no hangs
+# Coordinator-crash chaos (tests/test_recovery.py):
+#   coordinator  kill the coordinator mid multi-stage query — journal
+#                replay resumes it, committed stages re-read from the
+#                spool (zero recompute), clients ride nextUri through
+#                the restart, orphan tasks swept, spool GC'd
 # No subcommand runs the full seeded chaos schedule suite (-m chaos).
 #
 # Not part of the tier-1 gate (marked slow); run it before touching the
@@ -47,6 +52,11 @@ case "${1:-}" in
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_compile_resilience.py -q \
         -k "chaos" -p no:cacheprovider "$@"
+    ;;
+  coordinator)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_recovery.py -q \
+        -p no:cacheprovider "$@"
     ;;
   *)
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \

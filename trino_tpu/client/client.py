@@ -23,6 +23,7 @@ class StatementClient:
     def __init__(
         self, server_url: str, poll_interval: float = 0.05,
         spooled: bool = False, shed_retries: int = 0,
+        reattach: bool = True, reattach_max_elapsed_s: float = 30.0,
     ):
         """spooled=True advertises the SPOOLED result protocol (reference:
         client/spooling SegmentLoader): when the server has a spool
@@ -32,11 +33,19 @@ class StatementClient:
         shed_retries > 0 makes submission retry up to that many times when
         the coordinator load-sheds with 429, sleeping the server-suggested
         Retry-After between attempts (reference: the client honoring
-        TOO_MANY_REQUESTS backpressure instead of failing outright)."""
+        TOO_MANY_REQUESTS backpressure instead of failing outright).
+
+        reattach=True (default) rides nextUri polls through coordinator
+        death: connection errors retry with jittered exponential backoff
+        for up to reattach_max_elapsed_s — a journaled coordinator restart
+        resumes the query under the same id on the same port, so the poll
+        that finally lands gets the live state, not a dead socket."""
         self.server_url = server_url.rstrip("/")
         self.poll_interval = poll_interval
         self.spooled = spooled
         self.shed_retries = shed_retries
+        self.reattach = reattach
+        self.reattach_max_elapsed_s = reattach_max_elapsed_s
 
     def _post_statement(self, sql: str, headers: dict) -> dict:
         """POST /v1/statement, honoring 429 + Retry-After backpressure."""
@@ -77,6 +86,7 @@ class StatementClient:
         headers = {"X-Trino-Spooled": "1"} if self.spooled else {}
         state = self._post_statement(sql, headers)
         deadline = time.time() + timeout
+        backoff = None  # live only across a re-attach streak
         while True:
             if "segments" in state:
                 return state.get("columns", []), self._fetch_segments(state)
@@ -94,8 +104,41 @@ class StatementClient:
             if time.time() > deadline:
                 raise TimeoutError(f"query did not finish in {timeout}s")
             time.sleep(self.poll_interval)
-            with urllib.request.urlopen(next_uri, timeout=30) as r:
-                state = json.loads(r.read())
+            try:
+                with urllib.request.urlopen(next_uri, timeout=30) as r:
+                    state = json.loads(r.read())
+                backoff = None  # healthy poll resets the re-attach streak
+            except urllib.error.HTTPError as e:
+                # HTTPError subclasses OSError: handle it FIRST.  410 GONE
+                # is the typed resume_policy=FAIL refusal after a restart
+                if e.code == 410:
+                    try:
+                        detail = json.loads(e.read() or b"{}")
+                    except ValueError:
+                        detail = {}
+                    exc = QueryFailed(
+                        detail.get("error")
+                        or "query abandoned by coordinator restart"
+                    )
+                    exc.error_code = detail.get("errorCode")
+                    raise exc
+                raise
+            except OSError:
+                # coordinator death mid-poll: re-attach through Backoff
+                # (reference: the task-status fetcher retrying through
+                # Backoff before declaring the peer dead)
+                if not self.reattach:
+                    raise
+                if backoff is None:
+                    from ..runtime.failure import Backoff
+
+                    backoff = Backoff(
+                        min_delay=0.1, max_delay=2.0,
+                        max_elapsed=self.reattach_max_elapsed_s,
+                    )
+                if backoff.failure():
+                    raise
+                backoff.sleep()
 
     def submit(self, sql: str) -> str:
         """Fire-and-return: the query id (poll or cancel it later)."""

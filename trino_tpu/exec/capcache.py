@@ -10,8 +10,10 @@ processes — bench runs, CI re-runs, the next driver round — start at the
 converged tiers and compile exactly one program.
 
 Capacities depend only on the plan and the data, never on the host, so the
-cache file is committed to the repo (unlike the XLA compile cache, which
-bakes in host CPU features — utils/compilecache.py).
+cache survives process restarts under `.jax_cache/caps_cache.json` next to
+the XLA compile cache (utils/compilecache.py) — a build artifact, not a
+source file.  `TRINO_TPU_CAPS_CACHE` overrides the location (CI runs that
+want a warm start can point it at a persistent path).
 
 Reference analogue: runtime-adaptive statistics feedback
 (sql/planner/AdaptivePlanner.java) persisted across queries, in miniature.
@@ -41,9 +43,12 @@ _mem: Optional[dict] = None  # file contents, loaded once per process
 
 
 def _path() -> str:
+    env = os.environ.get("TRINO_TPU_CAPS_CACHE")
+    if env:
+        return env
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    return os.path.join(root, ".caps_cache.json")
+    return os.path.join(root, ".jax_cache", "caps_cache.json")
 
 
 def _key(plan, inputs: dict) -> str:
@@ -96,6 +101,9 @@ def store_caps(plan, inputs: dict, caps: dict[int, int]) -> None:
             for k in list(mem)[: len(mem) - _MAX_ENTRIES // 2]:
                 del mem[k]
         try:
+            parent = os.path.dirname(_path())
+            if parent:
+                os.makedirs(parent, exist_ok=True)
             tmp = _path() + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(mem, f, indent=0, sort_keys=True)

@@ -20,6 +20,10 @@ Recognized config.properties keys:
     exchange.spool-dir=/path        durable spooled exchange directory
     retry-policy=NONE|QUERY|TASK    default retry policy
     task.concurrency=4              worker executor pool width
+    query.journal-path=/path        durable query journal (crash recovery)
+    query.resume-policy=RESUME|FAIL|RESTART
+                                    what a restarted coordinator does with
+                                    journaled in-flight queries
 
 Connector factories (connector.name=):
     tpch (tpch.scale=), tpcds (tpcds.scale=), memory, blackhole,
@@ -119,6 +123,8 @@ class NodeConfig:
         self.exchange_spool_dir = props.get("exchange.spool-dir", "")
         self.retry_policy = props.get("retry-policy", "NONE")
         self.task_concurrency = int(props.get("task.concurrency", "4"))
+        self.journal_path = props.get("query.journal-path", "")
+        self.resume_policy = props.get("query.resume-policy", "")
 
 
 def load_node_config(etc_dir: str) -> NodeConfig:

@@ -44,8 +44,9 @@ from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
 from .failure import Backoff, FailureDetector
 from .history import QueryHistoryStore
+from .journal import QueryJournal
 from .memory import ClusterMemoryManager
-from .session import SessionProperties
+from .session import PROPERTIES, SessionProperties
 from .spool import SPOOL_URL, SpooledExchange
 from .statemachine import QueryStateMachine
 from .wire import wire_to_page
@@ -89,6 +90,7 @@ class Coordinator:
         cluster_memory_limit_bytes: int = 0,  # 0 = no enforcement
         history_capacity: int = 200,
         history_path: Optional[str] = None,
+        journal_path: Optional[str] = None,
     ):
         from .resourcegroups import ResourceGroupManager
 
@@ -162,6 +164,17 @@ class Coordinator:
             "trino_tpu_memory_revocations_requested_total",
             "Revocation (forced-spill) requests sent to workers",
         )
+        self._m_resumed = self.metrics.counter(
+            "trino_tpu_queries_resumed_total",
+            "In-flight queries a restarted coordinator recovered from the "
+            "journal, by outcome (completed/failed/refused)",
+            ("outcome",),
+        )
+        self._m_orphans = self.metrics.counter(
+            "trino_tpu_orphan_tasks_canceled_total",
+            "Worker tasks canceled by the post-restart sweep because their "
+            "query is not live in the journal",
+        )
         # query lifecycle events (reference: EventListener SPI fired from
         # QueryMonitor on the coordinator, not the workers)
         self.events = EventListenerManager()
@@ -184,6 +197,45 @@ class Coordinator:
             capacity=history_capacity,
             path=history_path or os.environ.get("TRINO_TPU_HISTORY_FILE"),
         )
+        # crash-simulation flag (kill()): scheduling threads bail between
+        # steps WITHOUT cleanup/terminal transitions — exactly the state a
+        # SIGKILLed process leaves behind
+        self._killed = False
+        # durable query journal (runtime/journal.py): admission, dispatch,
+        # spool commits, terminal states.  A restarted coordinator replays
+        # it here — synchronously, BEFORE the HTTP server opens, so client
+        # polls for a pre-crash query id never see a 404 window — and the
+        # resume thread (started in start()) takes over the in-flight ones.
+        self.journal: Optional[QueryJournal] = None
+        self.journal_replay_ms = 0.0
+        jpath = journal_path or os.environ.get("TRINO_TPU_JOURNAL_FILE")
+        if jpath:
+            t0 = time.perf_counter()
+            replayed = QueryJournal.replay(jpath)
+            self.journal = QueryJournal(jpath)
+            for qid, jq in replayed.items():
+                if jq.state != "INFLIGHT":
+                    # terminal: fold into history so GET /v1/query keeps
+                    # answering for it (its live record died with the crash)
+                    try:
+                        self.history.record({
+                            "query_id": qid, "state": jq.state,
+                            "sql": (jq.sql or "")[:500], "error": jq.error,
+                            "error_code": jq.error_code,
+                            "created_ts": jq.created_ts,
+                        })
+                    except Exception:
+                        traceback.print_exc()
+                    continue
+                sm = QueryStateMachine(qid)
+                self.queries[qid] = {
+                    "sm": sm, "sql": jq.sql, "result": None, "columns": None,
+                    "done": threading.Event(), "spooled": jq.spooled,
+                    "journaled": True, "resumed": True, "resume_state": jq,
+                }
+            self.journal_replay_ms = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
         self._hb_stop = threading.Event()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -197,6 +249,14 @@ class Coordinator:
     def start(self) -> "Coordinator":
         for t in self._threads:
             t.start()
+        if any(
+            rec.get("resume_state") is not None
+            for rec in self.queries.values()
+        ):
+            threading.Thread(
+                target=self._resume_replayed, daemon=True,
+                name="journal-resume",
+            ).start()
         # startup cache warming (runtime/warmup.py): replay the top-K
         # recurring FINISHED statements from the persisted history so their
         # XLA programs are compiled before the first client query hits the
@@ -240,13 +300,114 @@ class Coordinator:
     def stop(self) -> None:
         self._hb_stop.set()
         self.httpd.shutdown()
+        # release the port: a replacement coordinator must be able to bind
+        # the same address (clients re-attach to an unchanged nextUri)
+        self.httpd.server_close()
+        if self.journal is not None:
+            self.journal.close()
+
+    def kill(self) -> None:
+        """Crash analogue (in-process SIGKILL) for recovery tests: stop
+        serving and abandon all in-flight work exactly as a dead process
+        would — no task cleanup, no spool remove_query, no journal finish
+        records, no terminal state transitions.  Everything a real crash
+        leaves behind (running worker tasks, committed spool dirs, an
+        unterminated journal) is left behind here too."""
+        self._killed = True
+        self._hb_stop.set()
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+        if self.journal is not None:
+            self.journal.close()
+
+    # ---------------------------------------------------- journal recovery
+    def _resume_replayed(self) -> None:
+        """Take over the journal's in-flight queries (daemon thread from
+        start()).  Waits for workers to re-announce first — they survive
+        the coordinator and keep serving exchange fetches, so resuming
+        into an empty membership would fail every recovered query."""
+        deadline = time.monotonic() + 60.0
+        while not self._hb_stop.is_set():
+            if self.alive_workers() or time.monotonic() > deadline:
+                break
+            time.sleep(0.1)
+        from .resourcegroups import QueryRejected
+
+        with self._lock:
+            pending = [
+                rec for rec in self.queries.values()
+                if rec.get("resume_state") is not None
+            ]
+        for record in pending:
+            if self._hb_stop.is_set():
+                return
+            sm: QueryStateMachine = record["sm"]
+            jq = record.pop("resume_state")
+            policy = str(self.session.get("resume_policy") or "RESUME").upper()
+            # re-apply the journaled session overrides the query ran with,
+            # unless this coordinator was explicitly configured otherwise —
+            # retry_policy and exchange_spool_dir are load-bearing: without
+            # them the resumed query could not re-read its committed output
+            for k, v in (jq.session or {}).items():
+                if k in PROPERTIES and k not in self.session._values:
+                    self.session._values[k] = v
+            self.events.fire(
+                QueryEvent("resumed", sm.query_id, (jq.sql or "")[:500])
+            )
+            if policy == "FAIL":
+                reason = (
+                    "Query was abandoned by a coordinator restart "
+                    "(resume_policy=FAIL) [COORDINATOR_RESTART]"
+                )
+                record["resume_refused"] = True
+                if self.journal is not None:
+                    self.journal.append(
+                        "finish", sm.query_id, state="FAILED",
+                        error=reason, error_code="COORDINATOR_RESTART",
+                    )
+                sm.fail(reason, code="COORDINATOR_RESTART")
+                record["done"].set()
+                self._m_resumed.labels("refused").inc()
+                continue
+            if policy == "RESUME":
+                record["resume_commits"] = jq.commits
+                record["resume_ntasks"] = jq.dispatches
+            record["resume_attempt"] = jq.next_attempt
+            record["journal_replay_ms"] = self.journal_replay_ms
+            if self.journal is not None:
+                self.journal.append(
+                    "resume", sm.query_id, policy=policy,
+                    attempt=jq.next_attempt,
+                )
+
+            def start(record=record):
+                threading.Thread(
+                    target=self._run_admitted, args=(record,), daemon=True
+                ).start()
+
+            group = self.session.get("resource_group")
+            mem = int(self.session.get("query_max_memory_bytes") or 0)
+            try:
+                self.resource_groups.submit(group, sm.query_id, mem, start)
+            except QueryRejected as e:
+                sm.fail(str(e))
+                record["done"].set()
 
     # ------------------------------------------------------------ discovery
     def register_worker(self, url: str) -> None:
         with self._lock:
-            self.workers[url] = _WorkerInfo(url)
-        # a re-announcing worker (restart) starts with a clean bill of health
-        self.failure_detector.reset(url)
+            known = url in self.workers
+            if not known:
+                self.workers[url] = _WorkerInfo(url)
+        # a NEWLY announcing worker (first contact, or restart after a
+        # goodbye) starts with a clean bill of health; the periodic
+        # keep-alive announce from an already-registered worker must NOT
+        # reset the breaker — that would wipe an earned quarantine
+        if not known:
+            self.failure_detector.reset(url)
 
     def deregister_worker(self, url: str) -> None:
         """Goodbye-announce from a drained worker (reference: the discovery
@@ -305,6 +466,58 @@ class Coordinator:
             self._enforce_node_memory(mem_snapshots)
             self._enforce_deadlines()
             self._expire_old_queries()
+            self._sweep_orphan_tasks(infos)
+            self._gc_spool()
+
+    def _sweep_orphan_tasks(self, workers) -> None:
+        """Adopt-or-cancel sweep (journal-gated): list each worker's tasks
+        and DELETE those whose query this coordinator does not know as
+        live.  Pre-crash attempts of RESUMED queries stay adopted — their
+        committed output wins via the spool's first-commit-wins rename —
+        while tasks of terminal/unknown queries are orphans holding worker
+        memory that no consumer will ever fetch."""
+        if self.journal is None:
+            return
+        with self._lock:
+            live = {
+                qid for qid, rec in self.queries.items()
+                if not rec["sm"].done
+            }
+        for w in workers:
+            if not w.alive:
+                continue
+            try:
+                with urllib.request.urlopen(
+                    f"{w.url}/v1/task", timeout=2
+                ) as r:
+                    listing = json.loads(r.read())
+            except Exception:
+                continue  # old worker build or unreachable: skip
+            for t in listing.get("tasks") or []:
+                qid = t.get("query_id")
+                if not qid or qid in live:
+                    continue
+                self._delete_task_quiet(w.url, t["task_id"])
+                self._m_orphans.inc()
+
+    def _gc_spool(self) -> None:
+        """Periodic spool GC: drop committed/staging dirs of queries that
+        are neither live here nor younger than spool_gc_age_s (crashed
+        coordinators never call remove_query — see SpooledExchange.gc)."""
+        d = self.session.get("exchange_spool_dir") or ""
+        if not d or not os.path.isdir(d):
+            return
+        with self._lock:
+            live = {
+                qid for qid, rec in self.queries.items()
+                if not rec["sm"].done
+            }
+        try:
+            SpooledExchange(d).gc(
+                live, age_s=float(self.session.get("spool_gc_age_s") or 0.0)
+            )
+        except Exception:
+            traceback.print_exc()
 
     def _enforce_cluster_memory(self, by_query: dict[str, int]) -> None:
         """Kill the biggest reservation when the cluster exceeds its memory
@@ -503,6 +716,16 @@ class Coordinator:
         }
         with self._lock:
             self.queries[qid] = record
+        if self.journal is not None and isinstance(sql, str):
+            # admission is the journal's birth record: a crash after this
+            # point leaves enough (SQL + explicit session overrides) to
+            # re-plan the query under the same id
+            record["journaled"] = True
+            self.journal.append(
+                "admit", qid, sql=sql,
+                session=dict(self.session._values),
+                spooled=record["spooled"],
+            )
 
         def start():
             threading.Thread(
@@ -590,9 +813,21 @@ class Coordinator:
                 self._run_inner(record)
                 self.tracer.annotate(state=sm.state)
         finally:
+            if self._killed:
+                return  # crash simulation: the query ends mid-flight,
+                # un-terminal and un-journaled — recovery's starting state
             wall = time.perf_counter() - t0
             self._m_query_seconds.observe(wall)
             self._m_queries.labels(sm.state).inc()
+            if self.journal is not None and record.get("journaled"):
+                self.journal.append(
+                    "finish", sm.query_id, state=sm.state,
+                    error=sm.error, error_code=sm.error_code,
+                )
+            if record.get("resumed"):
+                self._m_resumed.labels(
+                    "completed" if sm.state == "FINISHED" else "failed"
+                ).inc()
             qi = record.get("query_info") or {}
             self.events.fire(
                 QueryEvent(
@@ -671,6 +906,11 @@ class Coordinator:
             # duration — their wall is inside executing_ms)
             "fallback_executions": int(qi.get("fallback_executions") or 0),
         }
+        if record.get("journal_replay_ms") is not None:
+            # resumed queries carry the restart's journal replay wall
+            ledger["journal_replay_ms"] = round(
+                float(record["journal_replay_ms"]), 3
+            )
         return ledger
 
     def _run_inner(self, record: dict) -> None:
@@ -709,6 +949,8 @@ class Coordinator:
                 sm.transition("FINISHED")
                 return
             except Exception as e:
+                if self._killed:
+                    return  # crash simulation: no terminal transition
                 if attempt < retries:
                     continue  # query-level retry (RetryPolicy QUERY)
                 if record.pop("requeue_spill", None):
@@ -923,8 +1165,17 @@ class Coordinator:
                     self.session.get("compile_deadline_s") or 0.0
                 ),
             }
-            tag = f"{sm.query_id}_a{attempt}_f{f.id}"
+            # resumed queries offset the attempt namespace past every
+            # journaled pre-crash attempt, so new task ids (and spool
+            # staging dirs) never collide with adopted pre-crash tasks
+            tag_attempt = attempt + int(record.get("resume_attempt") or 0)
+            tag = f"{sm.query_id}_a{tag_attempt}_f{f.id}"
             frag_meta[f.id] = (payload_base, tag)
+            if self.journal is not None and record.get("journaled"):
+                self.journal.append(
+                    "dispatch", sm.query_id, fragment=f.id,
+                    ntasks=ntasks[f.id], attempt=tag_attempt,
+                )
             return payload_base, tag
 
         def run_fragment_phased(f) -> None:
@@ -934,6 +1185,41 @@ class Coordinator:
                 )
             t0 = time.perf_counter() - t_query0
             payload_base, tag = build_payload(f)
+            # resumed query: parts whose pre-crash attempt COMMITTED to the
+            # spool are re-read, not recomputed — but only when the
+            # re-planned fragment kept the journaled fan-out (the cluster
+            # may have changed size across the restart)
+            pre: dict[int, str] = {}
+            rc = record.get("resume_commits")
+            if (
+                rc
+                and spool is not None
+                and (record.get("resume_ntasks") or {}).get(f.id)
+                == ntasks[f.id]
+            ):
+                pre = {
+                    p: tid
+                    for p, tid in (rc.get(f.id) or {}).items()
+                    if spool.is_committed(tid)  # trust the disk, not the log
+                }
+                if pre:
+                    record["parts_resumed"] = (
+                        record.get("parts_resumed", 0) + len(pre)
+                    )
+                    if len(pre) == ntasks[f.id]:
+                        record["stages_resumed"] = (
+                            record.get("stages_resumed", 0) + 1
+                        )
+
+            def on_commit(p: int, task_id: str, fid=f.id) -> None:
+                # a FINISHED task under the spooled exchange has durably
+                # committed its output (the worker commits before finish):
+                # journal it so a restart can skip this part
+                if self.journal is not None and record.get("journaled"):
+                    self.journal.append(
+                        "commit", sm.query_id, fragment=fid, part=p,
+                        task_id=task_id,
+                    )
 
             def refresh_sources(f=f):
                 # a consumer task may have failed because a SOURCE
@@ -959,6 +1245,8 @@ class Coordinator:
                 on_retry=lambda: record.__setitem__(
                     "task_retries", record.get("task_retries", 0) + 1
                 ),
+                precommitted=pre or None,
+                on_part_done=on_commit if spool is not None else None,
             )
             task_urls[f.id] = urls
             stage_times[f.id] = (t0, time.perf_counter() - t_query0)
@@ -1087,9 +1375,12 @@ class Coordinator:
             if record.get("spooled"):
                 self._spool_result(sm.query_id, record)
         finally:
-            self._cleanup_tasks(all_tasks)
-            if spool is not None:  # committed stage output dies with the query
-                spool.remove_query(sm.query_id)
+            if not self._killed:
+                self._cleanup_tasks(all_tasks)
+                if spool is not None:  # committed output dies with the query
+                    spool.remove_query(sm.query_id)
+            # on kill: leave tasks and spool dirs exactly where the crash
+            # found them — the restarted coordinator resumes from them
 
     # ------------------------------------------------------------ QueryInfo
     def _collect_query_info(
@@ -1262,6 +1553,17 @@ class Coordinator:
             "trace_id": record.get("trace_id", ""),
             "workers": self.failure_detector.snapshot(),
         }
+        if record.get("resumed"):
+            # crash-recovery provenance: rides QueryInfo into history and
+            # the EXPLAIN ANALYZE "recovery" footer (runtime/engine.py)
+            record["query_info"]["recovery"] = {
+                "resumed": True,
+                "stages_resumed": record.get("stages_resumed", 0),
+                "parts_resumed": record.get("parts_resumed", 0),
+                "journal_replay_ms": float(
+                    record.get("journal_replay_ms") or 0.0
+                ),
+            }
         # the phase ledger rides QueryInfo (reference: QueryStats planning/
         # execution/queued durations on GET /v1/query/{id}) and the EXPLAIN
         # ANALYZE footer; final state durations are refreshed at history time
@@ -1351,6 +1653,8 @@ class Coordinator:
         refresh_sources=None,
         should_abort=None,
         on_retry=None,
+        precommitted: Optional[dict[int, str]] = None,
+        on_part_done=None,
     ) -> list[tuple[str, str]]:
         """Post one stage's tasks, poll statuses, and re-schedule individual
         failures onto other alive workers (task-level recovery).  Every
@@ -1402,12 +1706,21 @@ class Coordinator:
                 return False  # dead/unreachable worker: reschedule below
 
         for p in range(nparts):
+            if precommitted and p in precommitted:
+                # crash recovery: a pre-crash attempt of this part already
+                # COMMITTED its output to the spool — consumers re-read it
+                # (SPOOL_URL source) and nothing is posted, the resume
+                # contract's "committed work is never recomputed"
+                urls[p] = (SPOOL_URL, precommitted[p])
+                continue
             w = workers[p % len(workers)]
             task_id = f"{tag}_p{p}_t0"
             try_post(p, w, task_id)
             pending[p] = [(w, task_id)]
             started[p] = time.monotonic()
         while pending:
+            if self._killed:
+                raise RuntimeError("coordinator killed")
             if should_abort is not None:
                 msg = should_abort()
                 if msg:
@@ -1429,6 +1742,8 @@ class Coordinator:
                 if finished:
                     winner = finished[0]
                     urls[p] = winner
+                    if on_part_done is not None:
+                        on_part_done(p, winner[1])
                     durations.append(time.monotonic() - started[p])
                     for a in atts:  # abort the speculation loser
                         if a != winner:
@@ -2029,6 +2344,15 @@ def _make_handler(coord: Coordinator):
                 if record is None:
                     return self._send_json(404, {"error": "unknown query"})
                 sm: QueryStateMachine = record["sm"]
+                if record.get("resume_refused"):
+                    # resume_policy=FAIL: a poll for a pre-restart query id
+                    # gets a typed 410 GONE instead of a silent 404, so a
+                    # re-attaching client surfaces COORDINATOR_RESTART
+                    # rather than retrying forever
+                    return self._send_json(
+                        410,
+                        {"error": sm.error, "errorCode": sm.error_code},
+                    )
                 if not sm.done:
                     return self._send_json(
                         200,

@@ -692,6 +692,16 @@ class Engine:
                     if isinstance(v, (int, float))
                 )
             )
+        # crash-recovery footer: present only on queries a restarted
+        # coordinator resumed from the journal (runtime/journal.py)
+        rec = info.get("recovery") or {}
+        if rec.get("resumed"):
+            text.append(
+                f"-- recovery: resumed from journal (replay "
+                f"{rec.get('journal_replay_ms', 0.0):.1f} ms, stages "
+                f"re-read from spool: {rec.get('stages_resumed', 0)}, "
+                f"parts re-read: {rec.get('parts_resumed', 0)})"
+            )
         # per-signature compile attribution: every distinct XLA program
         # the query built, with its persistent-cache outcome breakdown
         for sig, s in (info.get("compile_signatures") or {}).items():

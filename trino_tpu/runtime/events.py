@@ -14,7 +14,7 @@ __all__ = ["QueryEvent", "EventListenerManager"]
 
 @dataclass(frozen=True)
 class QueryEvent:
-    kind: str  # "created" | "completed" | "failed"
+    kind: str  # "created" | "completed" | "failed" | "resumed"
     query_id: str
     sql: str
     wall_s: float = 0.0

@@ -198,6 +198,26 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 0,
         ),
         _Prop(
+            "resume_policy", str, "RESUME",
+            "what a restarted coordinator does with in-flight journaled "
+            "queries (runtime/journal.py): RESUME re-plans and re-dispatches "
+            "only the fragments whose outputs did not COMMIT to the spool "
+            "(committed stages are re-read — the FTE re-read-not-recompute "
+            "promise applied to coordinator death); RESTART re-runs from "
+            "scratch under the same query id; FAIL refuses — polls for the "
+            "query answer 410 with a typed COORDINATOR_RESTART error",
+            lambda v: v in ("RESUME", "FAIL", "RESTART"),
+        ),
+        _Prop(
+            "spool_gc_age_s", float, 900.0,
+            "age threshold for the spooled-exchange GC sweep "
+            "(runtime/spool.py gc): committed task dirs and *.tmp-* staging "
+            "dirs whose query is neither live nor younger than this are "
+            "removed by the heartbeat sweep — crashed coordinators never "
+            "call remove_query, so their spool output leaks without it",
+            lambda v: v >= 0,
+        ),
+        _Prop(
             "query_max_memory_bytes", int, 0,
             "device-memory budget per query; 0 = auto (~80% of the "
             "accelerator's reported HBM), -1 = unlimited (never reroute). "

@@ -31,9 +31,21 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import Optional
+import time
+from typing import Iterable, Optional
+
+from ..utils import metrics as _metrics
 
 __all__ = ["SpooledExchange", "SPOOL_URL"]
+
+# registered at import so the family (with HELP) is present in every
+# /metrics scrape even before the first sweep removes anything
+_SPOOL_GC = _metrics.GLOBAL.counter(
+    "trino_tpu_spool_gc_total",
+    "Spool directories removed by the GC sweep (committed task dirs vs "
+    "*.tmp-* staging dirs left by crashed coordinators)",
+    ("kind",),
+)
 
 # sentinel "worker url" marking a source served from the spool, not HTTP
 SPOOL_URL = "spool"
@@ -135,3 +147,42 @@ class SpooledExchange:
         for name in names:
             if name.startswith(query_prefix + "_"):
                 shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)
+
+    def gc(
+        self,
+        live_query_ids: Iterable[str],
+        age_s: float = 0.0,
+        now: Optional[float] = None,
+    ) -> dict[str, int]:
+        """Sweep dirs whose query is NOT live and whose mtime is older than
+        `age_s` — a crashed coordinator never called remove_query, so its
+        committed task dirs and *.tmp-* staging dirs leak forever without
+        this.  The age threshold protects queries owned by OTHER
+        coordinators sharing the directory (tests, multi-coordinator dev
+        setups): anything actively written is young.  Returns removal
+        counts by kind."""
+        removed = {"committed": 0, "staging": 0}
+        live = list(live_query_ids)
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return removed
+        now = time.time() if now is None else now
+        for name in names:
+            if any(name.startswith(q + "_") for q in live):
+                continue
+            path = os.path.join(self.dir, name)
+            # only task/staging DIRS are spool-owned; stray files (e.g.
+            # out-of-core spill chunks sharing the directory) are not ours
+            if not os.path.isdir(path):
+                continue
+            try:
+                if age_s and now - os.path.getmtime(path) < age_s:
+                    continue
+            except OSError:
+                continue  # removed concurrently
+            kind = "staging" if ".tmp-" in name else "committed"
+            shutil.rmtree(path, ignore_errors=True)
+            removed[kind] += 1
+            _SPOOL_GC.labels(kind).inc()
+        return removed

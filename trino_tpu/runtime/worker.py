@@ -273,6 +273,10 @@ class Worker:
         # POST a goodbye-announce (deregister) instead of silently vanishing
         # and tripping the coordinator's circuit breaker
         self.coordinator_url: Optional[str] = None
+        # periodic re-announce cadence (0 disables); first announce fires
+        # one interval after start — the initial registration is explicit
+        self.announce_interval_s = 2.0
+        self._next_announce = time.monotonic() + self.announce_interval_s
         self._monitor_stop = threading.Event()
         self._monitor = threading.Thread(target=self._watchdog_loop, daemon=True)
         self._pool = ThreadPoolExecutor(max_workers=task_concurrency)
@@ -468,14 +472,42 @@ class Worker:
         except Exception:
             pass  # best-effort; the breaker's DRAINING overlay still holds
 
+    def _announce(self) -> None:
+        """Keep-alive announce to the coordinator (best-effort): while the
+        coordinator is down this fails silently and retries next interval;
+        the moment a replacement binds the port it re-registers us."""
+        try:
+            req = urllib.request.Request(
+                f"{self.coordinator_url}/v1/announce",
+                data=json.dumps({"url": self.url}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=2) as r:
+                r.read()
+        except Exception:
+            pass
+
     def _watchdog_loop(self) -> None:
         """No-progress watchdog: fail RUNNING tasks whose progress beats
         froze past their payload timeout while status still says RUNNING —
         today a wedged task blocks its consumer for the full status-poll
         ceiling (reference: stuck-task detection the coordinator's
-        QueryTracker does on frozen TaskStats)."""
+        QueryTracker does on frozen TaskStats)).
+
+        Also carries the periodic keep-alive announce: a coordinator
+        restarted while this worker kept serving re-learns the worker
+        within one announce interval, with no operator action — the
+        discovery-service heartbeat the reference nodes send."""
         while not self._monitor_stop.wait(0.25):
             now = time.monotonic()
+            if (
+                self.coordinator_url
+                and self.state == "active"
+                and self.announce_interval_s > 0
+                and now >= self._next_announce
+            ):
+                self._next_announce = now + self.announce_interval_s
+                self._announce()
             with self._lock:
                 tasks = list(self.tasks.values())
             for t in tasks:
@@ -1111,6 +1143,24 @@ def _make_handler(worker: Worker):
                     }
                 ).encode()
                 return self._send(200, body, "application/json")
+            # GET /v1/task — task listing for the coordinator's post-restart
+            # adopt-or-cancel sweep (reference: TaskResource's getAllTaskInfo
+            # that a fresh coordinator reconciles membership against)
+            if parts == ["v1", "task"]:
+                with worker._lock:
+                    listing = [
+                        {
+                            "task_id": t.task_id,
+                            "query_id": t.query_id,
+                            "state": t.state,
+                        }
+                        for t in worker.tasks.values()
+                    ]
+                return self._send(
+                    200,
+                    json.dumps({"tasks": listing}).encode(),
+                    "application/json",
+                )
             # /v1/task/{id}/status
             if len(parts) == 4 and parts[:2] == ["v1", "task"] and parts[3] == "status":
                 wait = float(params.get("wait", "0"))

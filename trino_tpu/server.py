@@ -37,13 +37,19 @@ def main(argv=None) -> int:
             default_catalog,
             port=cfg.port,
             cluster_memory_limit_bytes=cfg.cluster_memory_limit_bytes,
-        ).start()
+            journal_path=cfg.journal_path or None,
+        )
+        # session defaults are applied BEFORE start(): journal recovery
+        # (the resume thread) reads resume_policy / spool dir at takeover
         if cfg.query_max_memory_bytes:
             coord.session.set("query_max_memory_bytes", str(cfg.query_max_memory_bytes))
         if cfg.exchange_spool_dir:
             coord.session.set("exchange_spool_dir", cfg.exchange_spool_dir)
         if cfg.retry_policy != "NONE":
             coord.session.set("retry_policy", cfg.retry_policy)
+        if cfg.resume_policy:
+            coord.session.set("resume_policy", cfg.resume_policy)
+        coord.start()
         print(f"coordinator listening on {coord.url}", flush=True)
         try:
             while True:

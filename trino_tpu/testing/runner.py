@@ -30,6 +30,7 @@ class DistributedQueryRunner:
         worker_buffer_memory_bytes: Optional[int] = None,
         cluster_memory_limit_bytes: int = 0,
         node_memory_bytes: Optional[int] = None,
+        journal_path: Optional[str] = None,
     ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
@@ -38,6 +39,7 @@ class DistributedQueryRunner:
         self.worker_buffer_memory_bytes = worker_buffer_memory_bytes
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.node_memory_bytes = node_memory_bytes
+        self.journal_path = journal_path
         self.coordinator: Optional[Coordinator] = None
         self.workers: list[Worker] = []
 
@@ -50,6 +52,7 @@ class DistributedQueryRunner:
             self.default_catalog,
             heartbeat_interval=self.heartbeat_interval,
             cluster_memory_limit_bytes=self.cluster_memory_limit_bytes,
+            journal_path=self.journal_path,
         ).start()
         for _ in range(self.num_workers):
             w = Worker(
@@ -91,6 +94,51 @@ class DistributedQueryRunner:
         """Hard-stop a worker (the SIGKILL analogue): no drain, in-flight
         tasks are abandoned — recovery must come from retry/spool."""
         self.workers[index].kill()
+
+    def kill_coordinator(self) -> int:
+        """Crash the coordinator (the SIGKILL analogue): the HTTP server
+        stops and every scheduling thread abandons its work mid-flight —
+        no task cleanup, no spool remove_query, no journal finish.  Workers
+        keep running and serving their buffers.  Returns the port so a
+        restart can rebind the same client-visible URL."""
+        port = self.coordinator.port
+        self.coordinator.kill()
+        return port
+
+    def restart_coordinator(
+        self,
+        port: Optional[int] = None,
+        session: Optional[dict] = None,
+    ) -> Coordinator:
+        """Boot a replacement coordinator on the same port (clients keep
+        polling an unchanged nextUri) against the same catalogs and
+        journal.  `session` properties are applied BEFORE start() so the
+        journal-resume thread sees them (resume_policy, spool dir).  Live
+        workers are re-pointed and re-announced immediately — their own
+        periodic announce would also find it within one interval."""
+        port = port if port is not None else self.coordinator.port
+        self.coordinator = Coordinator(
+            self.catalogs,
+            self.default_catalog,
+            port=port,
+            heartbeat_interval=self.heartbeat_interval,
+            cluster_memory_limit_bytes=self.cluster_memory_limit_bytes,
+            journal_path=self.journal_path,
+        )
+        for name, value in (session or {}).items():
+            self.coordinator.session.set(name, str(value))
+        self.coordinator.start()
+        for w in self.workers:
+            w.coordinator_url = self.coordinator.url
+            try:
+                req = urllib.request.Request(
+                    f"{self.coordinator.url}/v1/announce",
+                    data=json.dumps({"url": w.url}).encode(),
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+            except Exception:
+                pass  # a killed worker can't be re-announced
+        return self.coordinator
 
     def query(self, sql: str) -> list[tuple]:
         """Direct (synchronous) execution through the scheduler."""
