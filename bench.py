@@ -46,7 +46,9 @@ BENCH_SF10_Q3 (default auto: runs if budget headroom remains),
 BENCH_WARM_BOUND (default 240),
 BENCH_CONCURRENCY (default 1; 0 disables), BENCH_CONC_CLIENTS (default 4),
 BENCH_CONC_QUERIES (default 5 per client), BENCH_CONC_SF (default 0.01),
-BENCH_CONC_SQL (default lineitem group-by).
+BENCH_CONC_SQL (default lineitem group-by), BENCH_CONC_REPEAT (default 0:
+hot-set fraction of queries repeating the shared statement — drives the
+result-cache hit rate; the section reports cache-on vs cache-off QPS).
 """
 
 import json
@@ -178,7 +180,14 @@ def _bench_concurrency(deadline) -> dict:
     (POST /v1/statement + nextUri polling against a 2-worker loopback
     cluster): QPS and tail latency under concurrent load.  Small scale
     factor on purpose — this measures scheduling/protocol throughput, not
-    scan bandwidth (the single-query sections above own that)."""
+    scan bandwidth (the single-query sections above own that).
+
+    BENCH_CONC_REPEAT (0..1, default 0) is the hot-set fraction: that share
+    of each client's queries is the one shared statement (dashboard-style
+    repeated load, result-cache hits), the rest get a distinct LIMIT
+    appended so every plan hash is unique (always misses).  The section
+    runs TWICE on the same cluster — result cache off, then on — so the
+    JSON carries a like-for-like speedup plus the hit/miss latency split."""
     import threading
 
     from trino_tpu.client import StatementClient
@@ -188,6 +197,7 @@ def _bench_concurrency(deadline) -> dict:
     clients = int(os.environ.get("BENCH_CONC_CLIENTS", "4"))
     per_client = int(os.environ.get("BENCH_CONC_QUERIES", "5"))
     conc_sf = float(os.environ.get("BENCH_CONC_SF", "0.01"))
+    repeat = min(1.0, max(0.0, float(os.environ.get("BENCH_CONC_REPEAT", "0"))))
     sql = os.environ.get(
         "BENCH_CONC_SQL",
         "select l_returnflag, count(*), sum(l_quantity) from lineitem "
@@ -196,18 +206,25 @@ def _bench_concurrency(deadline) -> dict:
     runner = DistributedQueryRunner(num_workers=2, default_catalog="tpch")
     runner.register_catalog("tpch", TpchConnector(conc_sf))
     runner.start()
-    try:
-        runner.query(sql)  # warm: compile lands outside the timed window
+
+    def run_pass() -> dict:
         lats: list[float] = []
         errors = [0]
         lock = threading.Lock()
+        hot_per_ten = int(round(repeat * 10))
 
-        def one_client():
+        def one_client(ci: int):
             c = StatementClient(runner.coordinator.url)
-            for _ in range(per_client):
+            for i in range(per_client):
+                # deterministic hot/cold interleave: `repeat` of every 10
+                # queries reuse the shared statement, the rest are unique
+                if (i % 10) < hot_per_ten:
+                    q = sql
+                else:
+                    q = f"{sql} limit {100000 + ci * per_client + i}"
                 t0 = time.perf_counter()
                 try:
-                    c.execute(sql, timeout=120)
+                    c.execute(q, timeout=120)
                 except Exception:
                     with lock:
                         errors[0] += 1
@@ -217,9 +234,10 @@ def _bench_concurrency(deadline) -> dict:
                         lats.append(dt)
 
         threads = [
-            threading.Thread(target=one_client, daemon=True)
-            for _ in range(clients)
+            threading.Thread(target=one_client, args=(ci,), daemon=True)
+            for ci in range(clients)
         ]
+        t_start = time.time()
         t0 = time.perf_counter()
         for t in threads:
             t.start()
@@ -231,22 +249,60 @@ def _bench_concurrency(deadline) -> dict:
             done = sorted(lats)
             errs = errors[0]
 
-        def pct(p):
-            if not done:
+        def pct(vals, p):
+            if not vals:
                 return None
-            return round(done[min(len(done) - 1, int(p * len(done)))] * 1000, 1)
+            return round(vals[min(len(vals) - 1, int(p * len(vals)))] * 1000, 1)
 
+        # server-side hit/miss latency split: the coordinator's live query
+        # records carry the cached flag and the state-machine timestamps
+        hit_walls: list[float] = []
+        miss_walls: list[float] = []
+        for rec in list(runner.coordinator.queries.values()):
+            sm = rec["sm"]
+            if sm.created_at < t_start - 0.25 or not sm.finished_at:
+                continue
+            (hit_walls if rec.get("cached") else miss_walls).append(
+                sm.finished_at - sm.created_at
+            )
+        hit_walls.sort()
+        miss_walls.sort()
+        n_seen = len(hit_walls) + len(miss_walls)
         return {
-            "clients": clients,
-            "queries_per_client": per_client,
-            "sf": conc_sf,
             "completed": len(done),
             "errors": errs + sum(1 for t in threads if t.is_alive()),
             "wall_s": round(wall, 3),
             "qps": round(len(done) / wall, 2) if wall > 0 else None,
-            "p50_ms": pct(0.50),
-            "p99_ms": pct(0.99),
+            "p50_ms": pct(done, 0.50),
+            "p99_ms": pct(done, 0.99),
+            "cache_hit_rate": (
+                round(len(hit_walls) / n_seen, 3) if n_seen else 0.0
+            ),
+            "hit_p50_ms": pct(hit_walls, 0.50),
+            "miss_p50_ms": pct(miss_walls, 0.50),
         }
+
+    try:
+        runner.query(sql)  # warm: compile lands outside the timed window
+        runner.coordinator.session.set("result_cache_enabled", "false")
+        off = run_pass()
+        runner.coordinator.session.set("result_cache_enabled", "true")
+        # the timed window is short — admit on first execution so the demo
+        # measures the cache, not the admission ramp
+        runner.coordinator.session.set("result_cache_min_recurrences", "0")
+        runner.coordinator.result_cache.clear()
+        on = run_pass()
+        out = {
+            "clients": clients,
+            "queries_per_client": per_client,
+            "sf": conc_sf,
+            "repeat_fraction": repeat,
+        }
+        out.update(on)
+        out["cache_disabled"] = off
+        if on.get("qps") and off.get("qps"):
+            out["qps_speedup_vs_nocache"] = round(on["qps"] / off["qps"], 2)
+        return out
     finally:
         runner.stop()
 

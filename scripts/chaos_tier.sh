@@ -19,6 +19,10 @@
 #                replay resumes it, committed stages re-read from the
 #                spool (zero recompute), clients ride nextUri through
 #                the restart, orphan tasks swept, spool GC'd
+# Result-cache chaos (tests/test_result_cache.py):
+#   cache   hot cached query under DML + worker kill + coordinator
+#           restart — typed invalidation and the cold-restart contract
+#           mean no step may ever return a stale row
 # No subcommand runs the full seeded chaos schedule suite (-m chaos).
 #
 # Not part of the tier-1 gate (marked slow); run it before touching the
@@ -57,6 +61,14 @@ case "${1:-}" in
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_recovery.py -q \
         -p no:cacheprovider "$@"
+    ;;
+  cache)
+    shift
+    # result/fragment-cache staleness chaos (tests/test_result_cache.py):
+    # hot cached query under DML + worker kill + coordinator restart — a
+    # stale row count at any step fails the run
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_result_cache.py -q \
+        -k "chaos or invalidat or restart" -p no:cacheprovider "$@"
     ;;
   *)
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \

@@ -38,6 +38,7 @@ try:
     print()
 
     assert text.count("Fragment") >= 2, "expected a multi-stage plan"
+    assert "-- cache:" in text, "expected the result-cache footer"
     bare = [
         ln for ln in text.splitlines()
         if ln.strip() and not ln.lstrip().startswith(("Fragment", "--", "wall:", "tasks:"))
@@ -48,10 +49,30 @@ try:
 
     coord = runner.coordinator
     base = coord.url
+
+    with coord._lock:
+        # newest record (insertion-ordered dict): the inner distributed
+        # query the EXPLAIN ANALYZE statement ran
+        qid = list(coord.queries)[-1]
+
+    # result-cache plane: admit immediately, run the hot query twice, and
+    # the hit counter must move (runtime/resultcache.py)
+    coord.session.set("result_cache_min_recurrences", "0")
+    runner.query(SQL)
+    runner.query(SQL)
+
     mtext = get(base + "/metrics")
     assert "trino_tpu_queries_total" in mtext
     assert "trino_tpu_tasks_dispatched_total" in mtext
-    print(f"coordinator /metrics: {len(mtext.splitlines())} lines ok")
+    hit_lines = [
+        ln for ln in mtext.splitlines()
+        if ln.startswith('trino_tpu_result_cache_events_total{event="hit"}')
+    ]
+    assert hit_lines and float(hit_lines[0].split()[-1]) > 0, (
+        f"expected a nonzero result-cache hit counter: {hit_lines}"
+    )
+    print(f"coordinator /metrics: {len(mtext.splitlines())} lines ok "
+          f"(result cache hits: {hit_lines[0].split()[-1]})")
 
     for w in runner.workers:
         wtext = get(f"{w.url}/metrics")
@@ -72,10 +93,6 @@ try:
     assert not failures, f"metrics lint: {failures}"
     print(f"metrics_lint: {len(targets)} targets clean")
 
-    with coord._lock:
-        # newest record (insertion-ordered dict): the inner distributed
-        # query the EXPLAIN ANALYZE statement ran
-        qid = list(coord.queries)[-1]
     info = json.loads(get(f"{base}/v1/query/{qid}"))
     assert info["stage_count"] >= 2 and info["cpu_ms"] > 0
     ledger = info.get("phase_ledger") or {}

@@ -238,6 +238,10 @@ def mem_cluster():
     )
     runner.register_catalog("mem", conn)
     runner.start()
+    # fault-injection tests arm per-worker fault budgets and need every
+    # repeated GROUP_SQL run to actually execute to consume them; a result
+    # cache hit would leave armed faults to leak into later tests
+    runner.coordinator.session.set("result_cache_enabled", "false")
     yield runner
     runner.stop()
 

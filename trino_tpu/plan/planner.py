@@ -2259,6 +2259,30 @@ class _Translator:
             import math as _math
 
             return Const(_math.pi, DOUBLE)
+        if name in ("now", "current_timestamp", "localtimestamp"):
+            # per-query constant, folded at plan time (Trino semantics: one
+            # now() per query, not per row) — microseconds since epoch on
+            # TIMESTAMP int64 lanes (data/types.py).  Because it folds to a
+            # fresh Const every planning, the plan hash changes per query
+            # and the result cache additionally bypasses on the AST
+            # (runtime/resultcache.py has_nondeterministic)
+            import time as _time
+
+            from ..data.types import TIMESTAMP
+
+            if e.args:
+                raise PlanningError(f"{name} takes no arguments")
+            return Const(int(_time.time() * 1e6), TIMESTAMP)
+        if name in ("random", "rand"):
+            # plan-time constant per query — a deviation from Trino's
+            # per-row random(), acceptable on traced lanes where runtime
+            # RNG state can't live in the plan; still non-deterministic
+            # ACROSS queries, which is what the cache bypass keys on
+            import random as _random
+
+            if e.args:
+                raise PlanningError(f"{name} takes no arguments")
+            return Const(_random.random(), DOUBLE)
         if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
                     "bitwise_left_shift", "bitwise_right_shift"):
             op = {

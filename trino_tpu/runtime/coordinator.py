@@ -46,6 +46,10 @@ from .failure import Backoff, FailureDetector
 from .history import QueryHistoryStore
 from .journal import QueryJournal
 from .memory import ClusterMemoryManager
+from .resultcache import (
+    MEMO_PREFIX, FragmentMemo, ResultCache, has_nondeterministic,
+    plan_version_vector,
+)
 from .session import PROPERTIES, SessionProperties
 from .spool import SPOOL_URL, SpooledExchange
 from .statemachine import QueryStateMachine
@@ -197,6 +201,12 @@ class Coordinator:
             capacity=history_capacity,
             path=history_path or os.environ.get("TRINO_TPU_HISTORY_FILE"),
         )
+        # result & fragment cache plane (runtime/resultcache.py): in-memory
+        # only, deliberately never journaled — a restarted coordinator comes
+        # up cold, so a snapshot that advanced while it was down can never
+        # be served stale.  Admission reads the history store above.
+        self.result_cache = ResultCache(history=self.history)
+        self.fragment_memo = FragmentMemo()
         # crash-simulation flag (kill()): scheduling threads bail between
         # steps WITHOUT cleanup/terminal transitions — exactly the state a
         # SIGKILLed process leaves behind
@@ -512,6 +522,9 @@ class Coordinator:
                 qid for qid, rec in self.queries.items()
                 if not rec["sm"].done
             }
+        # memoized fragment dirs (memo_*) are owned by the fragment memo —
+        # its eviction/invalidation deletes them; the age sweep must not
+        live.add(MEMO_PREFIX)
         try:
             SpooledExchange(d).gc(
                 live, age_s=float(self.session.get("spool_gc_age_s") or 0.0)
@@ -871,6 +884,11 @@ class Coordinator:
             "finished_ts": sm.finished_at,
             "wall_s": round(wall_s, 4),
             "rows": len(record["result"] or []),
+            # result-cache provenance: planhash feeds history-driven
+            # admission (ResultCache.admissible counts recurrences of it);
+            # cached marks hits — which still land here, by design
+            "planhash": (record.get("cache") or {}).get("planhash"),
+            "cached": bool(record.get("cached")),
         })
         return qi
 
@@ -911,6 +929,10 @@ class Coordinator:
             ledger["journal_replay_ms"] = round(
                 float(record["journal_replay_ms"]), 3
             )
+        if record.get("cached"):
+            # result-cache hit: the ledger shows a real lifecycle (queued/
+            # planning/running) but zero cluster execution
+            ledger["cached"] = True
         return ledger
 
     def _run_inner(self, record: dict) -> None:
@@ -918,6 +940,7 @@ class Coordinator:
         # full statement surface on the coordinator (reference: the
         # DataDefinitionTask family executes DDL coordinator-side while
         # embedded SELECTs run through the distributed scheduler)
+        query_ast = record["sql"]
         if isinstance(record["sql"], str):
             from ..sql import statements as S
 
@@ -941,35 +964,208 @@ class Coordinator:
                     traceback.print_exc()
                     sm.fail(str(e))
                 return
-        retries = 1 if self.session.get("retry_policy") == "QUERY" else 0
-        for attempt in range(retries + 1):
+            if stmt is not None:
+                query_ast = stmt.query
+        cs = self._result_cache_begin(record, query_ast)
+        if cs is not None and cs.get("rows") is not None:
+            # result-cache hit (a stored entry or an in-flight leader's
+            # rows): no cluster execution, but the full query lifecycle —
+            # state transitions, journal "finish", history record — still
+            # runs, so hits are indistinguishable from executions to
+            # clients and observability except for being instant
             try:
                 sm.transition("PLANNING")
-                self._run_once(record, attempt)
+                sm.transition("RUNNING")
+                if record.get("cancel"):
+                    raise RuntimeError("Query was canceled")
+                record["result"] = list(cs["rows"])
+                record["columns"] = list(cs["columns"] or [])
+                record["cached"] = True
+                self._cache_hit_info(record)
                 sm.transition("FINISHED")
-                return
             except Exception as e:
-                if self._killed:
-                    return  # crash simulation: no terminal transition
-                if attempt < retries:
-                    continue  # query-level retry (RetryPolicy QUERY)
-                if record.pop("requeue_spill", None):
-                    # graceful degradation on a cluster-memory kill: instead
-                    # of failing, re-run through the out-of-core executor —
-                    # sequential slices with disk exchanges bound the peak
-                    # footprint, trading latency for completion
-                    record["cancel"] = False
-                    try:
-                        self._requeue_out_of_core(record)
-                        sm.transition("FINISHED")
-                        return
-                    except Exception as e2:
-                        traceback.print_exc()
-                        sm.fail(f"{e}; out-of-core requeue failed: {e2}")
-                        return
-                traceback.print_exc()
                 sm.fail(str(e))
-                return
+            return
+        retries = 1 if self.session.get("retry_policy") == "QUERY" else 0
+        try:
+            for attempt in range(retries + 1):
+                try:
+                    sm.transition("PLANNING")
+                    self._run_once(record, attempt)
+                    self._result_cache_commit(record, cs)
+                    sm.transition("FINISHED")
+                    return
+                except Exception as e:
+                    if self._killed:
+                        return  # crash simulation: no terminal transition
+                    if attempt < retries:
+                        continue  # query-level retry (RetryPolicy QUERY)
+                    if record.pop("requeue_spill", None):
+                        # graceful degradation on a cluster-memory kill:
+                        # instead of failing, re-run through the out-of-core
+                        # executor — sequential slices with disk exchanges
+                        # bound the peak footprint, trading latency for
+                        # completion
+                        record["cancel"] = False
+                        try:
+                            self._requeue_out_of_core(record)
+                            self._result_cache_commit(record, cs)
+                            sm.transition("FINISHED")
+                            return
+                        except Exception as e2:
+                            traceback.print_exc()
+                            sm.fail(f"{e}; out-of-core requeue failed: {e2}")
+                            return
+                    traceback.print_exc()
+                    sm.fail(str(e))
+                    return
+        finally:
+            if cs is not None and cs.get("inflight") is not None:
+                # leader hand-off: publish rows to followers (None on any
+                # non-FINISHED exit so they execute themselves instead of
+                # waiting forever)
+                rows = record["result"] if sm.state == "FINISHED" else None
+                self.result_cache.finish(
+                    cs["key"], cs["inflight"], rows, record["columns"]
+                )
+
+    def _result_cache_begin(self, record: dict, query_ast):
+        """Resolve this query against the result cache BEFORE execution.
+
+        Returns None when caching is inapplicable (disabled, spooled-client
+        protocol, unparseable), else a cache-state dict: ``rows`` set means
+        serve from cache; otherwise the query executes and
+        ``_result_cache_commit`` stores it when admitted.  Also stamps
+        ``record["cache"]`` — the disposition that rides QueryInfo into the
+        EXPLAIN ANALYZE ``-- cache:`` footer and /v1/query."""
+        from ..utils.profiler import signature_of
+
+        if not self.session.get("result_cache_enabled"):
+            return None
+        if record.get("spooled"):
+            # spooled-protocol results live on disk as segments, not rows
+            return None
+        cache = self.result_cache
+        if not isinstance(query_ast, str) and has_nondeterministic(query_ast):
+            # checked on the AST: the planner folds now()/random() to
+            # per-query constants, invisible after planning
+            cache.count("bypass")
+            record["cache"] = {
+                "disposition": "bypass", "reason": "nondeterministic"
+            }
+            return None
+        try:
+            plan = optimize(
+                self.planner.plan(record["sql"]), self.catalogs, self.session
+            )
+        except Exception:
+            return None  # let the execution path raise the real error
+        # _run_once reuses this plan for attempt 0 (pop: retries re-plan)
+        record["_preplanned"] = plan
+        planhash = signature_of(plan)
+        record["cache"] = {"disposition": "bypass", "planhash": planhash}
+        vvec = plan_version_vector(plan, self.catalogs)
+        if vvec is None:
+            cache.count("bypass")
+            record["cache"]["reason"] = "time_travel"
+            return None
+        key = (planhash, vvec)
+        key_text = cache.key_text(key)
+        cs = {
+            "key": key, "key_text": key_text, "planhash": planhash,
+            "rows": None, "columns": None,
+        }
+        ttl = float(self.session.get("result_cache_ttl_s") or 0.0)
+        hit = cache.lookup(key, ttl_s=ttl)
+        analyze = bool(record.get("analyze"))
+        if hit is not None and not analyze:
+            cache.count("hit")
+            record["cache"] = {
+                "disposition": "hit", "key": key_text, "planhash": planhash,
+            }
+            cs["rows"], cs["columns"] = hit
+            return cs
+        record["cache"] = {
+            "disposition": "hit" if hit is not None else "miss",
+            "key": key_text, "planhash": planhash,
+        }
+        if analyze:
+            # EXPLAIN ANALYZE always executes (the stats ARE the result);
+            # it reports the disposition the plain query would have had,
+            # and never leads/stores — its rows are a plan, not data
+            cs["analyze"] = True
+            return cs
+        cs["store"] = cache.admissible(
+            planhash, int(self.session.get("result_cache_min_recurrences"))
+        )
+        if not cs["store"]:
+            # below the recurrence threshold nothing would be stored, so a
+            # concurrent duplicate gains nothing from waiting — and tests /
+            # workloads that rely on identical queries executing
+            # independently (memory-pressure probes) keep that behavior
+            cache.count("miss")
+            return cs
+        # in-flight dedup (the exec/compilesvc.py idiom): first identical
+        # concurrent admissible query leads, the rest wait and reuse its rows
+        leader, fl = cache.begin(key)
+        if leader:
+            cs["inflight"] = fl
+        else:
+            fl.event.wait(
+                timeout=float(self.session.get("query_max_run_time_s"))
+            )
+            if fl.rows is not None:
+                cache.count("hit")
+                record["cache"] = {
+                    "disposition": "hit", "key": key_text,
+                    "planhash": planhash, "deduplicated": True,
+                }
+                cs["rows"], cs["columns"] = fl.rows, fl.columns
+                return cs
+            # leader failed or timed out: execute ourselves, lead nothing
+        cache.count("miss")
+        return cs
+
+    def _result_cache_commit(self, record: dict, cs) -> None:
+        """After a successful execution: attach the cache disposition (and
+        fragment-memo counts) to QueryInfo, and store the result when the
+        history-driven admission said yes."""
+        qi = record.get("query_info")
+        info = dict(record.get("cache") or {})
+        for k in ("memo_hits", "memo_misses"):
+            if record.get(k):
+                info[k] = record[k]
+        if qi is not None and info:
+            qi["cache"] = info
+        if cs is None or cs.get("analyze") or not cs.get("store"):
+            return
+        if cs.get("disposition") == "hit":
+            return  # already stored; the entry stands
+        rows = record.get("result")
+        if rows is None:
+            return
+        cache = self.result_cache
+        cache.max_bytes = int(
+            self.session.get("result_cache_max_bytes") or cache.max_bytes
+        )
+        cache.store(cs["key"], list(rows), list(record.get("columns") or []))
+
+    def _cache_hit_info(self, record: dict) -> None:
+        """Minimal QueryInfo for a result served from the cache: no stages
+        ran, so the interesting fields are the output and the cache key."""
+        sm: QueryStateMachine = record["sm"]
+        record["query_info"] = {
+            "query_id": sm.query_id,
+            "stages": [],
+            "stage_count": 0,
+            "cpu_ms": 0.0,
+            "peak_memory_bytes": 0,
+            "compile_ms": 0.0,
+            "output_rows": len(record["result"] or []),
+            "cached": True,
+            "cache": dict(record.get("cache") or {}),
+        }
+        record["query_info"]["phase_ledger"] = self._phase_ledger(record)
 
     def _requeue_out_of_core(self, record: dict) -> None:
         """Re-run a memory-killed query coordinator-side with P sequential
@@ -1010,7 +1206,13 @@ class Coordinator:
             raise RuntimeError("no alive workers")
         nw = len(workers)
 
-        plan = optimize(self.planner.plan(record["sql"]), self.catalogs, self.session)
+        # the cache-begin hook already planned attempt 0 (for the plan hash
+        # + version vector); retries re-plan from scratch
+        plan = record.pop("_preplanned", None)
+        if plan is None:
+            plan = optimize(
+                self.planner.plan(record["sql"]), self.catalogs, self.session
+            )
         dplan = distribute(plan, self.catalogs, nw, self.session,
                            connector_buckets=True)
         fragments = fragment_plan(dplan)
@@ -1210,6 +1412,33 @@ class Coordinator:
                         record["stages_resumed"] = (
                             record.get("stages_resumed", 0) + 1
                         )
+            # fragment memoization (runtime/resultcache.py): a memoizable
+            # leaf fragment whose hash+version-vector matches an adopted
+            # memo_* spool dir seeds every part as a precommitted spool
+            # source — the PR 7 resume idiom, applied across queries
+            memo_key = None
+            if (
+                spool is not None
+                and not pre
+                and self.fragment_memo is not None
+                and self.session.get("result_cache_enabled")
+            ):
+                mk = FragmentMemo.fragment_key(f, payload_base, self.catalogs)
+                if mk is not None:
+                    key_m, vvec_m, tables_m = mk
+                    seeded = self.fragment_memo.lookup(
+                        key_m, vvec_m, ntasks[f.id], spool
+                    )
+                    if seeded is not None:
+                        pre = seeded
+                        FragmentMemo.count("hit")
+                        record["memo_hits"] = record.get("memo_hits", 0) + 1
+                    else:
+                        FragmentMemo.count("miss")
+                        record["memo_misses"] = (
+                            record.get("memo_misses", 0) + 1
+                        )
+                        memo_key = mk  # adopt this stage's dirs at the end
 
             def on_commit(p: int, task_id: str, fid=f.id) -> None:
                 # a FINISHED task under the spooled exchange has durably
@@ -1250,6 +1479,10 @@ class Coordinator:
             )
             task_urls[f.id] = urls
             stage_times[f.id] = (t0, time.perf_counter() - t_query0)
+            if memo_key is not None:
+                record.setdefault("memo_adopt", []).append(
+                    (memo_key, {p: tid for p, (_u, tid) in enumerate(urls)})
+                )
 
         try:
             non_result = [f for f in fragments if f.output_kind != "result"]
@@ -1374,6 +1607,19 @@ class Coordinator:
                 traceback.print_exc()
             if record.get("spooled"):
                 self._spool_result(sm.query_id, record)
+            # adopt memo-miss fragment outputs into the memo_* namespace
+            # BEFORE the finally's remove_query sweeps this query's dirs;
+            # a failure here must never fail a finished query
+            if spool is not None and not self._killed:
+                for (key_m, vvec_m, tables_m), parts in record.pop(
+                    "memo_adopt", []
+                ):
+                    try:
+                        self.fragment_memo.adopt(
+                            key_m, vvec_m, tables_m, parts, spool
+                        )
+                    except Exception:
+                        traceback.print_exc()
         finally:
             if not self._killed:
                 self._cleanup_tasks(all_tasks)
@@ -1990,6 +2236,11 @@ def _statement_surface(coord: "Coordinator"):
             ) or AllowAllAccessControl()
             self.user = "user"
             self.tracer = Tracer()
+            # write statements through this surface invalidate the
+            # COORDINATOR's caches (Engine.cache_invalidate), not a local
+            # engine's — same typed hooks as runtime/dml.py
+            self.result_cache = coord.result_cache
+            self.fragment_memo = coord.fragment_memo
 
         def plan(self, sql_or_query):
             return optimize(self.planner.plan(sql_or_query), self.catalogs, self.session)
@@ -2163,6 +2414,7 @@ def _make_handler(coord: Coordinator):
                         f"<tr><td>{_html.escape(str(qid))}</td>"
                         f"<td>{_html.escape(rec['sm'].state)}</td>"
                         f"{_age(rec['sm'])}"
+                        f"<td>{'hit' if rec.get('cached') else '-'}</td>"
                         f"<td><code>{_html.escape(str(rec.get('sql'))[:120])}</code></td></tr>"
                         for qid, rec in list(coord.queries.items())[-50:]
                     )
@@ -2198,6 +2450,7 @@ def _make_handler(coord: Coordinator):
                     f"<td>{_html.escape(str(h.get('state')))}</td>"
                     f"<td>{float(h.get('wall_s') or 0.0):.2f}</td>"
                     f"<td>{float((h.get('phase_ledger') or {}).get('compiling_ms') or 0.0):.0f}</td>"
+                    f"<td>{'hit' if h.get('cached') else '-'}</td>"
                     f"<td><code>{_html.escape(str(h.get('sql'))[:120])}</code></td></tr>"
                     for h in coord.history.list(limit=20)
                 )
@@ -2215,11 +2468,11 @@ def _make_handler(coord: Coordinator):
                     f"</tr>{wrows}</table>"
                     f"<h3>queries ({nqueries})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
-                    "<th>in state (s)</th><th>sql</th></tr>"
+                    "<th>in state (s)</th><th>cache</th><th>sql</th></tr>"
                     f"{qrows}</table>"
                     f"<h3>history ({len(coord.history)})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
-                    "<th>compile (ms)</th><th>sql</th></tr>"
+                    "<th>compile (ms)</th><th>cache</th><th>sql</th></tr>"
                     f"{hrows}</table></body></html>"
                 ).encode()
                 self.send_response(200)

@@ -69,6 +69,7 @@ def execute_delete(engine, stmt: S.Delete) -> int:
     old_n = conn.estimated_row_count(table) or 0
     if stmt.where is None:
         conn.truncate(table)
+        engine.cache_invalidate(f"{catalog}.{table}")
         return old_n
     survivors = Query(
         Select(
@@ -78,6 +79,7 @@ def execute_delete(engine, stmt: S.Delete) -> int:
         )
     )
     new_n = _replace(conn, table, engine, survivors)
+    engine.cache_invalidate(f"{catalog}.{table}")
     return old_n - new_n
 
 
@@ -115,6 +117,7 @@ def execute_update(engine, stmt: S.Update) -> int:
         )
         affected = int(engine.query(count_q)[0][0] or 0)
     _replace(conn, table, engine, rewrite)
+    engine.cache_invalidate(f"{catalog}.{table}")
     return affected
 
 
@@ -332,4 +335,5 @@ def execute_merge(engine, stmt: S.Merge) -> int:
         if snap is not None:
             conn.restore(snap)
         raise
+    engine.cache_invalidate(f"{catalog}.{table}")
     return upd_count + deleted + inserted

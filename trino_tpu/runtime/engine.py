@@ -105,6 +105,11 @@ class Engine:
         # reference: OpenTelemetry spans (SqlQueryExecution.java:473)
         self.tracer = Tracer()
         add_exporters_from_env(self.tracer)
+        # result & fragment caches (runtime/resultcache.py): attached by the
+        # coordinator's statement surface so DML executed here invalidates
+        # the coordinator's cached results; None on a plain local engine
+        self.result_cache = None
+        self.fragment_memo = None
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -341,6 +346,7 @@ class Engine:
                 name, [ColumnSchema(n, t) for n, t in zip(names, types)]
             )
             n = conn.insert(name, dict(zip(names, cols)))
+            self.cache_invalidate(stmt.name)
             return [(n,)]
 
         if isinstance(stmt, S.Insert):
@@ -360,16 +366,21 @@ class Engine:
                 _rescale_column(arr, t, schema.type_of(n))
                 for arr, t, n in zip(cols, types, names)
             ]
-            return [(self._insert_resolved(conn, table, names, cols),)]
+            n = self._insert_resolved(conn, table, names, cols)
+            self.cache_invalidate(stmt.table)
+            return [(n,)]
 
         if isinstance(stmt, S.InsertValues):
-            return [(self._insert_values(stmt),)]
+            n = self._insert_values(stmt)
+            self.cache_invalidate(stmt.table)
+            return [(n,)]
 
         if isinstance(stmt, S.DropTable):
             conn, name = self._target_conn(stmt.name)
             if stmt.if_exists and name not in conn.list_tables():
                 return [(0,)]
             conn.drop_table(name)
+            self.cache_invalidate(stmt.name)
             return [(0,)]
 
         if isinstance(stmt, S.CreateView):
@@ -692,6 +703,19 @@ class Engine:
                     if isinstance(v, (int, float))
                 )
             )
+        # result-cache footer (runtime/resultcache.py): the disposition the
+        # plain query would have had (EXPLAIN ANALYZE itself always
+        # executes) plus the cache key and any fragment-memo seeding
+        cinfo = info.get("cache") or {}
+        if cinfo.get("disposition"):
+            line = f"-- cache: {cinfo['disposition']}"
+            if cinfo.get("reason"):
+                line += f" ({cinfo['reason']})"
+            if cinfo.get("key"):
+                line += f" key={cinfo['key']}"
+            if cinfo.get("memo_hits"):
+                line += f" [fragment memo hits: {cinfo['memo_hits']}]"
+            text.append(line)
         # crash-recovery footer: present only on queries a restarted
         # coordinator resumed from the journal (runtime/journal.py)
         rec = info.get("recovery") or {}
@@ -731,6 +755,24 @@ class Engine:
                 + (f" [{'; '.join(flags)}]" if flags else "")
             )
         return text
+
+    def cache_invalidate(self, name: str) -> None:
+        """Typed result/fragment-cache invalidation for a mutated table —
+        every write statement (and runtime/dml.py) routes through here so a
+        cached result can never survive DML on a table it read."""
+        cache = getattr(self, "result_cache", None)
+        memo = getattr(self, "fragment_memo", None)
+        if cache is None and memo is None:
+            return
+        try:
+            _, catalog, table = self._target_ref(name)
+        except KeyError:
+            return  # dropping an unknown catalog's table: nothing cached
+        table = table.split(".")[-1]
+        if cache is not None:
+            cache.invalidate_table(catalog, table)
+        if memo is not None:
+            memo.invalidate_table(catalog, table)
 
     def _target_conn(self, name: str):
         """Resolve a possibly `catalog.table`-qualified DDL/DML target

@@ -218,6 +218,39 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 0,
         ),
         _Prop(
+            "result_cache_enabled", bool, True,
+            "coordinator result & fragment cache (runtime/resultcache.py): "
+            "repeated queries over unchanged snapshots are served from the "
+            "coordinator's result cache, and shared scan+filter fragment "
+            "prefixes are memoized via the spooled exchange (reference: "
+            "coordinator-side result reuse over immutable Iceberg "
+            "snapshots); time-travel and non-deterministic queries always "
+            "bypass",
+            None,
+        ),
+        _Prop(
+            "result_cache_min_recurrences", int, 2,
+            "history-driven admission threshold: a plan signature must "
+            "appear this many times in the query-history store "
+            "(runtime/history.py) before its result is cached — cache what "
+            "recurs, not what happens once; 0 admits everything",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "result_cache_ttl_s", float, 300.0,
+            "per-entry result-cache time-to-live: entries older than this "
+            "are dropped at lookup even when no invalidation fired "
+            "(a backstop for connectors without version tracking); "
+            "0 = no TTL",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "result_cache_max_bytes", int, 64 << 20,
+            "bytes budget for cached result rows; past it the "
+            "least-recently-hit entries are evicted",
+            lambda v: v >= 0,
+        ),
+        _Prop(
             "query_max_memory_bytes", int, 0,
             "device-memory budget per query; 0 = auto (~80% of the "
             "accelerator's reported HBM), -1 = unlimited (never reroute). "

@@ -134,6 +134,24 @@ class SpooledExchange:
                 out.append(blob)
         return out
 
+    def adopt(self, task_id: str, new_task_id: str) -> bool:
+        """Rename a COMMITTED task dir to a new id — fragment memoization
+        (runtime/resultcache.py) moves a finished query's fragment output
+        into the ``memo_…`` namespace so it survives that query's
+        remove_query.  First-wins like commit_task: renaming onto an
+        existing target fails and the source is left for its owner's
+        cleanup.  Returns True when THIS call published the new id."""
+        if not self.is_committed(task_id):
+            return False
+        try:
+            os.rename(
+                os.path.join(self.dir, task_id),
+                os.path.join(self.dir, new_task_id),
+            )
+            return True
+        except OSError:
+            return False
+
     # -------------------------------------------------------------- cleanup
     def remove_query(self, query_prefix: str) -> None:
         """Drop every committed task dir (and leftover staging dir) of one
