@@ -637,9 +637,11 @@ class LocalExecutor:
             (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x).__name__)))
             for x in leaves
         )
+        from ..ops.kernels import policy_key
+
         key = (plan, self.collect_operator_stats, tuple(sorted(caps.items())),
                tuple(sorted((k, p.capacity) for k, p in inputs.items())),
-               treedef, avals)
+               treedef, avals, policy_key())
         return key, treedef, avals
 
     def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
@@ -977,6 +979,85 @@ def _trace_plan(
             raise NotImplementedError(f"decimal128 columns through {what}")
         return stage
 
+    def _try_fused_aggregate(node: Aggregate, nid: int) -> Optional[_Stage]:
+        """Tentpole fusion: an Aggregate whose input is a straight
+        Filter/Project chain over a TableScan collapses into one Pallas
+        pass (ops/pallas/fused.py) that reads the scan columns from HBM
+        exactly once.  Predicates and aggregate arguments are substituted
+        down to scan level (plan/ir.substitute); anything the kernel can't
+        express — wide key domains, non-dictionary keys, aggregates beyond
+        sum/count/avg — declines here and takes the operator-at-a-time
+        path below, so this is a pure fast path."""
+        from ..ops import kernels as _kernels
+        from ..ops.pallas import fused as _fused
+        from ..plan.ir import FieldRef, substitute
+
+        if axis is not None:
+            return None  # sharded trace: per-shard partials need a merge
+        policy = _kernels.get_policy()
+        if not policy.enabled:
+            return None
+        if not (policy.interpret or jax.default_backend() == "tpu"):
+            return None
+        for a in node.aggs:
+            if a.distinct or a.arg2 is not None or a.order_keys:
+                return None
+        chain: list[PlanNode] = []
+        cur = node.child
+        while isinstance(cur, (Filter, Project)):
+            chain.append(cur)
+            cur = cur.child
+        if not isinstance(cur, TableScan):
+            return None
+        scan_nid = nid + 1 + len(chain)
+        page = pages.get(str(scan_nid))
+        if page is None or len(page.columns) != len(cur.output_types):
+            return None
+        scan_cols = [column_val(c) for c in page.columns]
+        for cv, t in zip(scan_cols, cur.output_types):
+            cv.type = t
+        colmap: list = [FieldRef(i, t) for i, t in enumerate(cur.output_types)]
+        filters = []
+        for link in reversed(chain):
+            if isinstance(link, Filter):
+                filters.append(substitute(link.predicate, colmap))
+            else:
+                colmap = [substitute(e, colmap) for e in link.expressions]
+        keys = [substitute(k, colmap) for k in node.group_keys]
+        args = [
+            None if a.arg is None else substitute(a.arg, colmap)
+            for a in node.aggs
+        ]
+        recipe, _why = _fused.plan_pipeline(
+            scan_cols, filters, keys,
+            [a.fn for a in node.aggs], args, [a.type for a in node.aggs],
+        )
+        if recipe is None:
+            return None
+        counter[0] = scan_nid + 1  # consume the whole chain's id range
+        live = page.live_mask()
+        _kernels.record_dispatch(
+            "fused_pipeline", "pallas",
+            f"{len(filters)} filters {len(recipe.streams)} streams "
+            f"domain {recipe.domain}",
+        )
+        totals = _fused.run(recipe, scan_cols, live, interpret=policy.interpret)
+        key_codes, agg_cols, out_live, n_groups = _fused.assemble(recipe, totals)
+        report(nid, n_groups)
+        if collect_stats:
+            count_rows(scan_nid, live)
+        cols: list[ColumnVal] = []
+        for code, ke, (ci, _, _) in zip(key_codes, node.group_keys, recipe.keys):
+            cols.append(ColumnVal(code, None, scan_cols[ci].dict, ke.type))
+        for out, a in zip(agg_cols, node.aggs):
+            hi = None
+            if len(out) == 4:  # decimal128 sum: (lo, valid, None, hi)
+                data, valid, _d, hi = out
+            else:
+                data, valid = out
+            cols.append(ColumnVal(data, valid, None, a.type, data2=hi))
+        return _Stage(cols, out_live)
+
     def _emit(node: PlanNode) -> _Stage:
         nid = counter[0]
         counter[0] += 1
@@ -1021,6 +1102,9 @@ def _trace_plan(
             return _Stage(cols, s.live)
 
         if isinstance(node, Aggregate):
+            fused = _try_fused_aggregate(node, nid)
+            if fused is not None:
+                return fused
             s = emit(node.child)
             G = caps[nid]
             keys = [eval_expr(k, s.cols, s.capacity) for k in node.group_keys]
@@ -1207,7 +1291,14 @@ def _trace_plan(
 
         raise NotImplementedError(f"node {type(node).__name__}")
 
-    stage = emit(plan)
+    from ..ops import kernels as _kernels
+
+    events = _kernels.begin_capture()
+    try:
+        stage = emit(plan)
+    finally:
+        _kernels.end_capture()
+    _kernels.remember(plan, events)
     out_page = Page(
         tuple(
             Column(cv.type, cv.data, cv.valid, cv.dict, cv.data2)

@@ -255,8 +255,11 @@ class SpmdExecutor(LocalExecutor):
             out_page, required = smap(step)(inputs)
             return out_page, jax.device_get(required)
 
+        from ..ops.kernels import policy_key
+
         cache_key = ("spmd", plan, collect, tuple(sorted(caps.items())),
-                     tuple(sorted((k, p.capacity) for k, p in inputs.items())))
+                     tuple(sorted((k, p.capacity) for k, p in inputs.items())),
+                     policy_key())
         if cache_key not in self._jit_cache:
             smapped = smap(step)
             # pack overflow counters into one vector (see LocalExecutor._run:

@@ -1,0 +1,127 @@
+"""Data-plane kernel policy and dispatch accounting.
+
+The Pallas data-plane kernels (ops/pallas/hashagg.py, hashjoin.py, fused.py)
+replace the sort-based relational hot paths (ops/relops.py) when a static
+gate says the shape fits — group/build cardinality inside the VMEM hash
+table, key types encodable as i32 words, aggregate set fully fusable.  This
+module is the one place that decision is configured and observed:
+
+  * KernelPolicy — per-statement knobs (runtime/session.py properties
+    `data_plane_kernels`, `hash_agg_kernel_limit`, `hash_join_kernel_limit`,
+    `pallas_interpret`), re-applied by the engine before each statement the
+    same way compile props are.
+  * record_dispatch() — increments
+    trino_tpu_kernel_dispatch_total{op,impl=pallas|sort|fallback} and, while
+    a plan trace is active, appends the event to that trace's capture so
+    EXPLAIN ANALYZE can print `-- kernel:` footer lines.  Dispatch is
+    recorded at TRACE time (kernel selection), once per compiled program —
+    a jit-cache hit re-runs the selected kernel without re-counting.
+  * events_for(plan) — the captured events of the last trace of `plan`
+    (plans are frozen dataclasses, so they key a bounded dict directly).
+
+impl values: "pallas" = the Pallas kernel was selected; "sort" = the static
+gate chose the legacy sort path (disabled, unencodable keys, unsupported
+shape, or a non-TPU backend without interpret); "fallback" = the shape was
+kernel-eligible but exceeded the policy's capacity limit
+(hash_agg_kernel_limit / hash_join_kernel_limit), so the sort path ran.
+A selected kernel still carries a runtime overflow guard — hash-table
+overflow or probe exhaustion divert that execution to the sort path without
+re-counting.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..utils import metrics as _metrics
+
+__all__ = [
+    "KernelPolicy", "get_policy", "set_policy", "policy_key",
+    "record_dispatch", "begin_capture", "end_capture", "remember",
+    "events_for",
+]
+
+
+@dataclass(frozen=True)
+class KernelPolicy:
+    enabled: bool = True            # master kill switch (data_plane_kernels)
+    hash_agg_max_groups: int = 2048  # group cap above which group-by sorts
+    hash_join_max_build: int = 2048  # build rows above which joins sort
+    interpret: bool = False         # run kernels interpreted (CPU CI path)
+
+
+_DEFAULT = KernelPolicy()
+_POLICY = _DEFAULT
+
+_DISPATCH = _metrics.GLOBAL.counter(
+    "trino_tpu_kernel_dispatch_total",
+    "Data-plane kernel selections at plan-trace time, by relational op "
+    "(group_by | join | fused_pipeline) and implementation (pallas = Pallas "
+    "TPU kernel, sort = legacy sort path, fallback = kernel-eligible shape "
+    "past the policy capacity limit, sort path ran)",
+    ("op", "impl"),
+)
+
+
+def get_policy() -> KernelPolicy:
+    return _POLICY
+
+
+def set_policy(policy: KernelPolicy) -> None:
+    global _POLICY
+    _POLICY = policy
+
+
+def policy_key() -> tuple:
+    """Fingerprint for executor jit-cache keys: a changed policy must compile
+    a new program (the kernel choice is baked into the trace)."""
+    p = _POLICY
+    return (p.enabled, p.hash_agg_max_groups, p.hash_join_max_build, p.interpret)
+
+
+# --------------------------------------------------------- event capture
+
+_TLS = threading.local()
+_EVENTS_LOCK = threading.Lock()
+_EVENTS: dict = {}  # plan -> tuple[(op, impl, detail)]
+_EVENTS_MAX = 256
+
+
+def record_dispatch(op: str, impl: str, detail: str = "") -> None:
+    _DISPATCH.labels(op=op, impl=impl).inc()
+    cap = getattr(_TLS, "capture", None)
+    if cap is not None:
+        cap.append((op, impl, detail))
+
+
+def begin_capture() -> list:
+    cap: list = []
+    _TLS.capture = cap
+    return cap
+
+
+def end_capture() -> None:
+    _TLS.capture = None
+
+
+def remember(plan, events) -> None:
+    """Associate a trace's dispatch events with its plan (last trace wins —
+    the retry loop's final capacities decide the kernels that actually ran)."""
+    try:
+        hash(plan)
+    except TypeError:
+        return
+    with _EVENTS_LOCK:
+        if len(_EVENTS) >= _EVENTS_MAX:
+            _EVENTS.clear()
+        _EVENTS[plan] = tuple(events)
+
+
+def events_for(plan) -> tuple:
+    try:
+        hash(plan)
+    except TypeError:
+        return ()
+    with _EVENTS_LOCK:
+        return _EVENTS.get(plan, ())

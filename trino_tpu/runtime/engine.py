@@ -208,6 +208,21 @@ class Engine:
                 ex.compile_deadline_s = float(
                     self.session.get("compile_deadline_s") or 0.0
                 )
+        self._apply_kernel_props()
+
+    def _apply_kernel_props(self) -> None:
+        """Session → data-plane kernel policy (ops/kernels.py): re-applied
+        per statement like the compile props.  The policy fingerprint rides
+        the executor jit-cache key, so SET SESSION flips recompile rather
+        than silently reusing a program traced under the old policy."""
+        from ..ops import kernels as _kernels
+
+        _kernels.set_policy(_kernels.KernelPolicy(
+            enabled=bool(self.session.get("data_plane_kernels")),
+            hash_agg_max_groups=int(self.session.get("hash_agg_kernel_limit")),
+            hash_join_max_build=int(self.session.get("hash_join_kernel_limit")),
+            interpret=bool(self.session.get("pallas_interpret")),
+        ))
 
     def _execute_planned(self, plan) -> Page:
         self._apply_compile_props()
@@ -591,6 +606,13 @@ class Engine:
                 f"-- output rows: {len(page.to_pylist())}, wall: {wall * 1000:.1f} ms"
             )
             text.extend(self._profile_footer(ex, n_ev0))
+            from ..ops.kernels import events_for
+
+            for op, impl, detail in events_for(plan):
+                text.append(
+                    f"-- kernel: {impl} {op}"
+                    + (f" ({detail})" if detail else "")
+                )
             return [(line,) for line in text]
         rows = self.query(stmt.query)
         wall = _time.perf_counter() - t0

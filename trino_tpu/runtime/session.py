@@ -260,6 +260,31 @@ PROPERTIES: dict[str, _Prop] = {
             "(exec/spill.py; reference: spiller/ + revocable memory)",
             lambda v: v >= -1,
         ),
+        _Prop(
+            "data_plane_kernels", bool, True,
+            "master switch for the Pallas data-plane kernels (hash "
+            "group-by, hash join, fused scan pipelines; ops/pallas/). "
+            "false restores the legacy sort-based paths bit-for-bit",
+            None,
+        ),
+        _Prop(
+            "hash_agg_kernel_limit", int, 2048,
+            "group-count capacity above which group-by takes the sort "
+            "path instead of the Pallas VMEM hash table",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "hash_join_kernel_limit", int, 2048,
+            "build-side rows above which equi-joins take the sort path "
+            "instead of the Pallas VMEM hash table",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "pallas_interpret", bool, False,
+            "run the data-plane kernels in pallas interpret mode (CPU "
+            "CI path: same kernel code, no Mosaic compile)",
+            None,
+        ),
     ]
 }
 
