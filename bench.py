@@ -53,7 +53,14 @@ BENCH_CONCURRENCY (default 1; 0 disables), BENCH_CONC_CLIENTS (default 4),
 BENCH_CONC_QUERIES (default 5 per client), BENCH_CONC_SF (default 0.01),
 BENCH_CONC_SQL (default lineitem group-by), BENCH_CONC_REPEAT (default 0:
 hot-set fraction of queries repeating the shared statement — drives the
-result-cache hit rate; the section reports cache-on vs cache-off QPS).
+result-cache hit rate; the section reports cache-on vs cache-off QPS),
+BENCH_CONC_PREPARED (default 0; 1 adds the serving-fast-path section:
+PREPARE once / EXECUTE with varying parameters through the parameterized
+plan cache vs the same workload as ad-hoc SQL text — every literal change
+replanned and retraced — reporting both QPS/p50/p99 and the speedup),
+BENCH_CONC_BATCH_MS (default 0: execute_batch_window_ms applied to the
+prepared pass — concurrent same-plan EXECUTEs merge into one vmapped
+device dispatch).
 """
 
 import json
@@ -312,6 +319,127 @@ def _bench_concurrency(deadline) -> dict:
         runner.stop()
 
 
+def _bench_prepared(deadline) -> dict:
+    """Serving fast path (runtime/fastpath.py): PREPARE once, EXECUTE with a
+    different parameter every time, against the same workload issued the old
+    way — distinct literal SQL text per query, so every statement re-parses,
+    re-plans, and re-traces.  Same cluster, same data, same clients; the
+    only variable is whether parameters ride the parameterized plan cache as
+    jit arguments or get baked into fresh plans as constants.
+
+    The prepared pass replays the client-held registry header
+    (X-Trino-Prepared-Statement) instead of a server-side PREPARE, i.e. the
+    stateless-client mode a connection pool would use."""
+    import threading
+
+    from trino_tpu.client import StatementClient
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing import DistributedQueryRunner
+
+    clients = int(os.environ.get("BENCH_CONC_CLIENTS", "4"))
+    per_client = int(os.environ.get("BENCH_CONC_QUERIES", "5"))
+    conc_sf = float(os.environ.get("BENCH_CONC_SF", "0.01"))
+    batch_ms = float(os.environ.get("BENCH_CONC_BATCH_MS", "0"))
+    template = (
+        "select l_returnflag, count(*) c, sum(l_quantity) s from lineitem "
+        "where l_quantity < ? group by l_returnflag order by l_returnflag"
+    )
+
+    def param(ci: int, i: int) -> float:
+        # distinct per (client, query) so the ad-hoc pass can never reuse a
+        # plan and the prepared pass proves value-independence
+        return 1.5 + ((ci * per_client + i) * 7) % 47
+
+    runner = DistributedQueryRunner(num_workers=2, default_catalog="tpch")
+    runner.register_catalog("tpch", TpchConnector(conc_sf))
+    runner.start()
+
+    def run_pass(prepared: bool) -> dict:
+        lats: list[float] = []
+        errors = [0]
+        lock = threading.Lock()
+
+        def one_client(ci: int):
+            c = StatementClient(runner.coordinator.url)
+            if prepared:
+                c.prepared["bp"] = template
+            for i in range(per_client):
+                v = param(ci, i)
+                if prepared:
+                    q = f"EXECUTE bp USING {v}"
+                else:
+                    q = template.replace("?", str(v))
+                t0 = time.perf_counter()
+                try:
+                    c.execute(q, timeout=300)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                else:
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+
+        threads = [
+            threading.Thread(target=one_client, args=(ci,), daemon=True)
+            for ci in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        join_by = time.perf_counter() + max(deadline.remaining(), 60.0)
+        for t in threads:
+            t.join(timeout=max(join_by - time.perf_counter(), 0.1))
+        wall = time.perf_counter() - t0
+        with lock:
+            done = sorted(lats)
+            errs = errors[0]
+
+        def pct(vals, p):
+            if not vals:
+                return None
+            return round(vals[min(len(vals) - 1, int(p * len(vals)))] * 1000, 1)
+
+        return {
+            "completed": len(done),
+            "errors": errs + sum(1 for t in threads if t.is_alive()),
+            "wall_s": round(wall, 3),
+            "qps": round(len(done) / wall, 2) if wall > 0 else None,
+            "p50_ms": pct(done, 0.50),
+            "p99_ms": pct(done, 0.99),
+        }
+
+    try:
+        # both passes measure the plan path, not the result cache; distinct
+        # parameters per query would defeat it anyway, this makes it explicit
+        runner.coordinator.session.set("result_cache_enabled", "false")
+        # warm data residency + the prepared statement's one compile; the
+        # ad-hoc pass gets the same residency warmth (its plans can't be
+        # pre-compiled — that asymmetry IS the thing being measured)
+        c = StatementClient(runner.coordinator.url)
+        c.prepared["bp"] = template
+        c.execute("EXECUTE bp USING 0.5")
+        adhoc = run_pass(prepared=False)
+        if batch_ms > 0:
+            runner.coordinator.session.set(
+                "execute_batch_window_ms", str(batch_ms)
+            )
+        prep = run_pass(prepared=True)
+        out = {
+            "clients": clients,
+            "queries_per_client": per_client,
+            "sf": conc_sf,
+            "batch_window_ms": batch_ms,
+        }
+        out.update(prep)
+        out["adhoc"] = adhoc
+        if prep.get("qps") and adhoc.get("qps"):
+            out["qps_speedup_vs_adhoc"] = round(prep["qps"] / adhoc["qps"], 2)
+        return out
+    finally:
+        runner.stop()
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1"))
     runs = int(os.environ.get("BENCH_RUNS", "5"))
@@ -549,6 +677,14 @@ def main() -> None:
             result["concurrency"] = _bench_concurrency(deadline)
         except Exception as e:
             result["concurrency"] = {"error": str(e)[:200]}
+        emit()
+
+    # ---- serving fast path: PREPARE/EXECUTE vs ad-hoc text (ISSUE 10) ----
+    if os.environ.get("BENCH_CONC_PREPARED", "0") == "1" and deadline.remaining() > 60:
+        try:
+            result["prepared"] = _bench_prepared(deadline)
+        except Exception as e:
+            result["prepared"] = {"error": str(e)[:200]}
         emit()
 
     # sqlite baselines LAST (the expendable part of the budget); cached

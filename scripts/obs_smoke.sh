@@ -61,6 +61,26 @@ try:
     runner.query(SQL)
     runner.query(SQL)
 
+    # serving fast path (runtime/fastpath.py): PREPARE over the protocol,
+    # EXECUTE twice with distinct parameters — miss then hit on the
+    # parameterized plan cache — and EXPLAIN ANALYZE EXECUTE must carry
+    # the `-- fastpath:` footer with the cache disposition
+    from trino_tpu.client import StatementClient
+
+    sc = StatementClient(base)
+    sc.execute("PREPARE obs_fp FROM select l_returnflag, count(*) c "
+               "from lineitem where l_quantity < ? group by l_returnflag "
+               "order by l_returnflag")
+    assert "obs_fp" in sc.prepared, "addedPrepare delta not applied"
+    sc.execute("EXECUTE obs_fp USING 10.0")
+    sc.execute("EXECUTE obs_fp USING 20.0")
+    _, fprows = sc.execute("EXPLAIN ANALYZE EXECUTE obs_fp USING 20.0")
+    fptext = "\n".join(r[0] for r in fprows)
+    fplines = [ln for ln in fptext.splitlines() if ln.startswith("-- fastpath:")]
+    assert fplines, f"expected a fastpath footer:\n{fptext}"
+    assert "plan_cache=hit" in fplines[0], fplines
+    print(f"fastpath: {fplines[0]}")
+
     mtext = get(base + "/metrics")
     assert "trino_tpu_queries_total" in mtext
     assert "trino_tpu_tasks_dispatched_total" in mtext
@@ -73,6 +93,15 @@ try:
     )
     print(f"coordinator /metrics: {len(mtext.splitlines())} lines ok "
           f"(result cache hits: {hit_lines[0].split()[-1]})")
+
+    pc_hits = [
+        ln for ln in mtext.splitlines()
+        if ln.startswith('trino_tpu_plan_cache_events_total{event="hit"}')
+    ]
+    assert pc_hits and float(pc_hits[0].split()[-1]) > 0, (
+        f"expected a nonzero plan-cache hit counter: {pc_hits}"
+    )
+    print(f"plan cache hits: {pc_hits[0].split()[-1]}")
 
     for w in runner.workers:
         wtext = get(f"{w.url}/metrics")

@@ -1,0 +1,296 @@
+"""Serving fast path: parameterized plan cache, zero-retrace EXECUTE,
+small-query batching, and the prepared-statement protocol surface.
+
+The contract under test (runtime/fastpath.py): a PREPAREd statement plans
+once, compiles once, and every subsequent EXECUTE with different parameter
+values reuses the same XLA program — values travel as jit *arguments*, not
+trace-time constants.  The profiler ledger (utils/profiler.py) is the
+witness: one signature, compiles == 1, executes == number of bindings.
+"""
+
+import threading
+
+import pytest
+
+from trino_tpu.utils.profiler import PROFILER
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.runtime.engine import Engine
+
+    eng = Engine(default_catalog="tpch")
+    eng.register_catalog("tpch", TpchConnector(0.01))
+    return eng
+
+
+def _new_sigs(before, after):
+    """Signatures whose execute count grew between two profiler snapshots."""
+    out = {}
+    for sig, e in after.items():
+        prev = before.get(sig, {"executes": 0, "compiles": 0})
+        de = e["executes"] - prev["executes"]
+        if de > 0:
+            out[sig] = {
+                "executes": de,
+                "compiles": e["compiles"] - prev["compiles"],
+            }
+    return out
+
+
+# --------------------------------------------------------------- zero retrace
+def test_zero_retrace_across_bindings(engine):
+    """>= 3 distinct bindings share ONE compiled signature (compiles == 1)."""
+    engine.execute(
+        "PREPARE zr FROM select l_returnflag, count(*) c, sum(l_quantity) q "
+        "from lineitem where l_quantity < ? group by l_returnflag "
+        "order by l_returnflag"
+    )
+    before = PROFILER.snapshot()
+    for v in (11.0, 24.0, 37.0, 49.0):
+        engine.execute(f"EXECUTE zr USING {v}")
+    grown = _new_sigs(before, PROFILER.snapshot())
+    assert len(grown) == 1, f"expected one fastpath signature, got {grown}"
+    (_, stats), = grown.items()
+    assert stats["compiles"] == 1, f"retraced across bindings: {stats}"
+    assert stats["executes"] == 4
+
+
+def test_bindings_match_full_replan_oracle(engine):
+    engine.execute(
+        "PREPARE orc FROM select l_returnflag, count(*) c from lineitem "
+        "where l_quantity < ? group by l_returnflag order by l_returnflag"
+    )
+    for v in (5.0, 24.0, 49.0):
+        got = engine.execute(f"EXECUTE orc USING {v}")
+        want = engine.query(
+            "select l_returnflag, count(*) c from lineitem "
+            f"where l_quantity < {v} group by l_returnflag order by l_returnflag"
+        )
+        assert got == want, (v, got, want)
+
+
+def test_bigint_binding(engine):
+    engine.execute("PREPARE bk FROM select n_name from nation where n_regionkey = ? order by n_name")
+    for k in (0, 1, 2):
+        got = engine.execute(f"EXECUTE bk USING {k}")
+        want = engine.query(
+            f"select n_name from nation where n_regionkey = {k} order by n_name"
+        )
+        assert got == want
+
+
+# ----------------------------------------------------------------- plan cache
+def test_plan_cache_hit_events(engine):
+    from trino_tpu.runtime.fastpath import PLAN_CACHE_EVENTS
+
+    engine.execute("PREPARE pc FROM select count(*) from orders where o_custkey = ?")
+    h0, m0 = PLAN_CACHE_EVENTS.value("hit"), PLAN_CACHE_EVENTS.value("miss")
+    engine.execute("EXECUTE pc USING 7")
+    engine.execute("EXECUTE pc USING 13")
+    engine.execute("EXECUTE pc USING 29")
+    assert PLAN_CACHE_EVENTS.value("miss") - m0 == 1
+    assert PLAN_CACHE_EVENTS.value("hit") - h0 == 2
+
+
+def test_injection_quote_bearing_string_param(engine):
+    """A quote-bearing varchar parameter stays DATA (the old textual
+    substitution would have spliced it into the predicate)."""
+    engine.execute("PREPARE inj FROM select count(*) from nation where n_name = ?")
+    got = engine.execute("EXECUTE inj USING 'x'' or ''1''=''1'")
+    assert got == [(0,)]
+    got = engine.execute("EXECUTE inj USING 'FRANCE'")
+    assert got == [(1,)]
+
+
+def test_plan_cache_invalidation_on_dml():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.engine import Engine
+    from trino_tpu.runtime.fastpath import PLAN_CACHE_EVENTS
+
+    eng = Engine(default_catalog="mem")
+    eng.register_catalog("mem", MemoryConnector())
+    eng.execute("CREATE TABLE t (a bigint, b bigint)")
+    eng.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    eng.execute("PREPARE p FROM select b from t where a = ?")
+    assert eng.execute("EXECUTE p USING 2") == [(20,)]
+    inv0 = PLAN_CACHE_EVENTS.value("invalidated")
+    eng.execute("INSERT INTO t VALUES (4, 40)")
+    # the stale plan (pinned to the pre-INSERT table version) must NOT serve
+    assert eng.execute("EXECUTE p USING 4") == [(40,)]
+    assert PLAN_CACHE_EVENTS.value("invalidated") > inv0
+
+
+def test_kill_switch_falls_back_to_legacy(engine):
+    from trino_tpu.runtime.fastpath import PLAN_CACHE_EVENTS
+
+    engine.execute("PREPARE ks FROM select count(*) from nation where n_regionkey = ?")
+    engine.execute("SET SESSION prepared_fastpath_enabled = false")
+    try:
+        before = PLAN_CACHE_EVENTS.value("hit") + PLAN_CACHE_EVENTS.value("miss")
+        got = engine.execute("EXECUTE ks USING 0")
+        assert got == [(5,)]
+        after = PLAN_CACHE_EVENTS.value("hit") + PLAN_CACHE_EVENTS.value("miss")
+        assert after == before, "kill switch did not bypass the plan cache"
+    finally:
+        engine.execute("SET SESSION prepared_fastpath_enabled = true")
+
+
+def test_execute_arity_mismatch(engine):
+    engine.execute("PREPARE ar FROM select count(*) from nation where n_regionkey = ?")
+    with pytest.raises(Exception, match="parameter"):
+        engine.execute("EXECUTE ar USING 1, 2")
+
+
+# ------------------------------------------------------------------- batching
+def test_batched_dispatch_matches_sequential_oracle(engine):
+    from trino_tpu.runtime.fastpath import EXECUTE_BATCH
+
+    engine.execute(
+        "PREPARE bat FROM select l_returnflag, count(*) c from lineitem "
+        "where l_quantity < ? group by l_returnflag order by l_returnflag"
+    )
+    engine.execute("EXECUTE bat USING 24.0")  # warm: learn caps + compile
+    b0 = EXECUTE_BATCH.value("batched")
+    engine.execute("SET SESSION execute_batch_window_ms = 25")
+    try:
+        vals = [5.0, 10.0, 15.0, 20.0, 25.0, 30.0]
+        results, errors = {}, []
+
+        def run(v):
+            try:
+                results[v] = engine.execute(f"EXECUTE bat USING {v}")
+            except Exception as e:  # surfaced below; threads must not die silently
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(v,)) for v in vals]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+    finally:
+        engine.execute("SET SESSION execute_batch_window_ms = 0")
+    assert not errors, errors
+    for v in vals:
+        want = engine.query(
+            "select l_returnflag, count(*) c from lineitem "
+            f"where l_quantity < {v} group by l_returnflag order by l_returnflag"
+        )
+        assert results[v] == want, (v, results[v], want)
+    assert EXECUTE_BATCH.value("batched") > b0, "window never formed a batch"
+
+
+def test_unbatchable_plan_falls_back_pipelined(engine):
+    """A plan marked un-vmappable still answers every query in the window
+    (per-query pipelined dispatch), counted under outcome=fallback."""
+    from trino_tpu.runtime.fastpath import EXECUTE_BATCH
+
+    engine.execute(
+        "PREPARE nb FROM select count(*) c from orders where o_custkey = ?"
+    )
+    engine.execute("EXECUTE nb USING 7")  # warm + create the cache entry
+    fp = engine.fastpath()
+    with fp._lock:
+        entries = [e for k, e in fp._cache.items() if k[0].startswith("select count(*) c from orders")]
+    assert entries, "prepared plan missing from the cache"
+    for e in entries:
+        e.batchable = False  # force the can't-batch path
+    f0 = EXECUTE_BATCH.value("fallback")
+    engine.execute("SET SESSION execute_batch_window_ms = 25")
+    try:
+        keys = [1, 2, 3, 4]
+        results, errors = {}, []
+
+        def run(k):
+            try:
+                results[k] = engine.execute(f"EXECUTE nb USING {k}")
+            except Exception as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(k,)) for k in keys]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+    finally:
+        engine.execute("SET SESSION execute_batch_window_ms = 0")
+    assert not errors, errors
+    for k in keys:
+        want = engine.query(f"select count(*) c from orders where o_custkey = {k}")
+        assert results[k] == want, (k, results[k], want)
+    assert EXECUTE_BATCH.value("fallback") > f0
+
+
+# ------------------------------------------------------------------- protocol
+@pytest.fixture(scope="module")
+def cluster():
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing.runner import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(num_workers=2, default_catalog="tpch")
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    yield runner
+    runner.stop()
+
+
+def test_protocol_prepare_execute_deallocate(cluster):
+    from trino_tpu.client import StatementClient
+
+    c = StatementClient(cluster.coordinator.url)
+    sql = (
+        "select l_returnflag, count(*) c from lineitem where l_quantity < ? "
+        "group by l_returnflag order by l_returnflag"
+    )
+    c.execute(f"PREPARE proto FROM {sql}")
+    # server-side PREPARE echoes into the client registry (addedPrepare)
+    assert c.prepared.get("proto") == sql
+
+    cols, rows = c.execute("EXECUTE proto USING 24.0")
+    assert cols == ["l_returnflag", "c"], cols
+    assert rows
+
+    # a FRESH client holding only the header registry (no server session):
+    # the X-Trino-Prepared-Statement header alone must resolve the EXECUTE
+    c2 = StatementClient(cluster.coordinator.url)
+    c2.prepared["proto"] = sql
+    cols2, rows2 = c2.execute("EXECUTE proto USING 24.0")
+    assert (cols2, rows2) == (cols, rows)
+
+    c.execute("DEALLOCATE PREPARE proto")
+    assert "proto" not in c.prepared  # deallocatedPrepare delta applied
+
+
+def test_protocol_explain_analyze_footer(cluster):
+    from trino_tpu.client import StatementClient
+
+    c = StatementClient(cluster.coordinator.url)
+    c.prepared["ef"] = "select count(*) from nation where n_regionkey = ?"
+    c.execute("EXECUTE ef USING 1")
+    _, rows = c.execute("EXPLAIN ANALYZE EXECUTE ef USING 1")
+    text = "\n".join(r[0] for r in rows)
+    assert "-- fastpath:" in text, text
+    assert "plan_cache=hit" in text, text
+
+
+def test_dbapi_binds_instead_of_splicing(cluster):
+    from trino_tpu.client.dbapi import connect
+
+    conn = connect(cluster.coordinator.url)
+    cur = conn.cursor()
+    # regression: a quote-bearing parameter must not terminate the predicate
+    cur.execute(
+        "select count(*) from nation where n_name = ?", ("x' or '1'='1",)
+    )
+    assert cur.fetchone() == (0,)
+    cur.execute("select count(*) from nation where n_name = ?", ("FRANCE",))
+    assert cur.fetchone() == (1,)
+    # the statement went through the prepared registry, not text splicing
+    assert any(k.startswith("dbapi_") for k in conn._client.prepared)
+    # repeats reuse the registry slot (one server plan-cache entry)
+    n = len(conn._client.prepared)
+    cur.execute("select count(*) from nation where n_name = ?", ("KENYA",))
+    assert len(conn._client.prepared) == n

@@ -4,9 +4,19 @@ RIGHT/FULL).  Reference semantics: LookupJoinOperator + LookupOuterOperator
 for FULL, unmatched build rows null-extend the probe columns exactly once,
 even when the join is hash-partitioned across devices."""
 
+import sqlite3
+
 import pytest
 
 from tests.oracle import assert_rows_equal
+
+# the differential oracle needs sqlite >= 3.39 for RIGHT/FULL OUTER JOIN;
+# on older runtimes those cases have no oracle to diff against
+_HAS_FULL_JOIN = sqlite3.sqlite_version_info >= (3, 39)
+_NEEDS_ORACLE_FULL = pytest.mark.skipif(
+    not _HAS_FULL_JOIN,
+    reason=f"sqlite {sqlite3.sqlite_version} lacks RIGHT/FULL OUTER JOIN",
+)
 
 QUERIES = {
     "full_basic": (
@@ -47,12 +57,21 @@ def engine(tpch_tiny):
     return eng
 
 
-@pytest.mark.parametrize("name", sorted(QUERIES))
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param(n, marks=_NEEDS_ORACLE_FULL)
+        if n.startswith(("full", "right"))
+        else n
+        for n in sorted(QUERIES)
+    ],
+)
 def test_outer_join(name, engine, oracle):
     sql = QUERIES[name]
     assert_rows_equal(engine.query(sql), oracle.query(sql), ordered=False)
 
 
+@_NEEDS_ORACLE_FULL
 def test_outer_join_distributed(oracle):
     import jax
 

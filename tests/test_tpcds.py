@@ -15,6 +15,25 @@ from tests.tpcds_queries import ORDERED, QUERIES
 
 SCALE = 0.002
 
+# Queries excluded from the tier-1 gate (`-m 'not slow'`).  The full
+# parametrized suite takes ~15 min on the CPU mesh — alone over the tier-1
+# wall budget — so every case that measured >= ~4 s (multi-channel UNION
+# rollups, wide star joins, windowed year-over-year comparisons) runs only
+# in the unmarked full suite.  q51/q58/q97 additionally hit sqlite oracle
+# limitations and q59 a known mismatch — tracked independently of the gate.
+# The remaining ~50 fast cases (~2.5 min total) keep every operator family
+# covered: scans/filters (q03 q42 q52 q55), hash joins (q07 q19 q25 q26),
+# group-by rollups (q43 q53 q65), semi/anti joins (q16 q94), CASE channels
+# (q34 q73 q90), date windows (q12 q20 q98), subquery decorrelation
+# (q01 q06 q30), and the north-star q64 shape via q64lite.
+SLOW = frozenset({
+    "q02", "q04", "q05", "q10", "q11", "q14", "q18", "q22", "q23", "q24",
+    "q27", "q31", "q33", "q35", "q36", "q38", "q39", "q47", "q49", "q51",
+    "q54", "q56", "q57", "q58", "q59", "q60", "q61", "q63", "q64", "q66",
+    "q67", "q70", "q72", "q74", "q75", "q77", "q78", "q80", "q81", "q83",
+    "q85", "q86", "q87", "q88", "q89", "q91", "q93", "q95", "q97",
+})
+
 
 @pytest.fixture(scope="module")
 def tpcds_tables():
@@ -46,7 +65,13 @@ def engine():
     return eng
 
 
-@pytest.mark.parametrize("name", sorted(QUERIES))
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param(n, marks=pytest.mark.slow) if n in SLOW else n
+        for n in sorted(QUERIES)
+    ],
+)
 def test_tpcds_query(name, engine, tpcds_oracle):
     sql = QUERIES[name]
     got = engine.query(sql)

@@ -9,6 +9,7 @@ import json
 import time
 import urllib.request
 from typing import Optional
+from urllib.parse import quote
 
 __all__ = ["StatementClient", "QueryFailed"]
 
@@ -46,6 +47,12 @@ class StatementClient:
         self.shed_retries = shed_retries
         self.reattach = reattach
         self.reattach_max_elapsed_s = reattach_max_elapsed_s
+        # client-held prepared-statement registry (reference: ClientSession
+        # preparedStatements): replayed on every request via the
+        # X-Trino-Prepared-Statement header, updated from the terminal
+        # response's addedPrepare / deallocatedPrepare deltas, so EXECUTE
+        # works against a stateless (or restarted) coordinator
+        self.prepared: dict[str, str] = {}
 
     def _post_statement(self, sql: str, headers: dict) -> dict:
         """POST /v1/statement, honoring 429 + Retry-After backpressure."""
@@ -81,16 +88,28 @@ class StatementClient:
                 pass  # best-effort release; server GC covers the rest
         return rows
 
+    def _apply_prepared_deltas(self, state: dict) -> None:
+        for name, text in (state.get("addedPrepare") or {}).items():
+            self.prepared[name] = text
+        for name in state.get("deallocatedPrepare") or ():
+            self.prepared.pop(name, None)
+
     def execute(self, sql: str, timeout: float = 600.0) -> tuple[list[str], list[list]]:
         """-> (column_names, rows)"""
         headers = {"X-Trino-Spooled": "1"} if self.spooled else {}
+        if self.prepared:
+            headers["X-Trino-Prepared-Statement"] = ",".join(
+                f"{quote(n)}={quote(s)}" for n, s in self.prepared.items()
+            )
         state = self._post_statement(sql, headers)
         deadline = time.time() + timeout
         backoff = None  # live only across a re-attach streak
         while True:
             if "segments" in state:
+                self._apply_prepared_deltas(state)
                 return state.get("columns", []), self._fetch_segments(state)
             if "data" in state:
+                self._apply_prepared_deltas(state)
                 return state.get("columns", []), state["data"]
             if state.get("stats", {}).get("state") == "FAILED":
                 exc = QueryFailed(state.get("error", "query failed"))

@@ -15,6 +15,9 @@ anything that expects a PEP 249 driver.
 
 from __future__ import annotations
 
+import datetime
+import decimal
+import hashlib
 from typing import Any, Iterator, Optional, Sequence
 
 from .client import QueryFailed, StatementClient
@@ -46,38 +49,34 @@ class OperationalError(DatabaseError):
     pass
 
 
-def _quote_param(v: Any) -> str:
+def _render_literal(v: Any) -> str:
+    """One parameter value as a single typed literal token for EXECUTE...
+    USING.  Unlike the old qmark text substitution this never splices user
+    data into the statement body: the statement ships verbatim (via the
+    prepared registry header) and the value arrives as one literal the
+    server binds by type — a quote in a string can only ever extend the
+    string token ('' doubling), never terminate the expression."""
     if v is None:
         return "null"
     if isinstance(v, bool):
         return "true" if v else "false"
-    if isinstance(v, (int, float)):
-        return repr(v)
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        # exponent form lexes as an approximate (DOUBLE) literal; a bare
+        # "24.0" would lex as exact decimal(3,1) and change the slot type
+        return f"{v!r}e0" if "e" not in repr(v) else repr(v)
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    if isinstance(v, datetime.date) and not isinstance(v, datetime.datetime):
+        return f"date '{v.isoformat()}'"
     return "'" + str(v).replace("'", "''") + "'"
 
 
-def _substitute(sql: str, params: Sequence[Any]) -> str:
-    """qmark substitution, skipping ? inside string literals."""
-    out, it = [], iter(params)
-    in_str = False
-    i = 0
-    while i < len(sql):
-        c = sql[i]
-        if c == "'":
-            in_str = not in_str
-            out.append(c)
-        elif c == "?" and not in_str:
-            try:
-                out.append(_quote_param(next(it)))
-            except StopIteration:
-                raise ProgrammingError("not enough parameters for statement")
-        else:
-            out.append(c)
-        i += 1
-    leftover = list(it)
-    if leftover:
-        raise ProgrammingError(f"{len(leftover)} unused parameters")
-    return "".join(out)
+def _prepared_name(operation: str) -> str:
+    # deterministic per statement text: repeated execute() of the same
+    # operation reuses one registry slot (and one server plan-cache entry)
+    return "dbapi_" + hashlib.sha1(operation.encode()).hexdigest()[:12]
 
 
 class Cursor:
@@ -94,7 +93,28 @@ class Cursor:
     def execute(self, operation: str, parameters: Sequence[Any] = ()) -> "Cursor":
         if self._conn._client is None:
             raise ProgrammingError("connection is closed")
-        sql = _substitute(operation, parameters) if parameters else operation
+        if parameters:
+            # bind, don't splice: the statement text goes into the client's
+            # prepared registry (shipped by header, cached server-side by
+            # the parameterized plan cache) and values travel as typed
+            # EXECUTE ... USING literals
+            n_slots, in_str = 0, False
+            for c in operation:
+                if c == "'":
+                    in_str = not in_str
+                elif c == "?" and not in_str:
+                    n_slots += 1
+            if len(parameters) != n_slots:
+                raise ProgrammingError(
+                    f"statement takes {n_slots} parameters, got {len(parameters)}"
+                )
+            name = _prepared_name(operation)
+            self._conn._client.prepared[name] = operation
+            sql = f"EXECUTE {name} USING " + ", ".join(
+                _render_literal(v) for v in parameters
+            )
+        else:
+            sql = operation
         try:
             columns, rows = self._conn._client.execute(sql)
         except QueryFailed as e:

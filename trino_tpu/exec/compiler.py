@@ -28,7 +28,7 @@ import numpy as np
 from ..connectors.spi import CatalogManager
 from ..data.page import Column, Page
 from ..data.types import Type
-from ..ops.expr import ColumnVal, column_val, eval_expr, eval_predicate
+from ..ops.expr import ColumnVal, column_val, eval_expr, eval_predicate, param_context
 from ..ops.relops import (
     AggSpec, SortSpec, broadcast_single_row, compact_rows, equi_join,
     group_aggregate, limit_mask, sort_rows, top_n, unnest_expand,
@@ -290,10 +290,17 @@ class LocalExecutor:
 
     # ------------------------------------------------------------ execution
     def execute(
-        self, plan: PlanNode, remote_pages: Optional[dict[int, Page]] = None
+        self,
+        plan: PlanNode,
+        remote_pages: Optional[dict[int, Page]] = None,
+        params: tuple = (),
     ) -> Page:
         """remote_pages: fragment_id -> input Page for RemoteSource leaves
-        (multi-host task execution, runtime/worker.py)."""
+        (multi-host task execution, runtime/worker.py).  `params`: bound
+        prepared-statement parameter values (typed numpy scalars, one per
+        ir.Param index) fed to the compiled program as jit ARGUMENTS — every
+        binding of one prepared plan reuses a single compiled program
+        (runtime/fastpath.py)."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -330,7 +337,8 @@ class LocalExecutor:
                 # the round-1 4.5–222s/query pathology.  Cheap eager loop,
                 # then a single full jit below.
                 for _ in range(16):
-                    _, required = _trace_plan(plan, inputs, caps)
+                    with param_context(params):
+                        _, required = _trace_plan(plan, inputs, caps)
                     overflow = {
                         nid: int(req)
                         for nid, req in required.items()
@@ -365,12 +373,13 @@ class LocalExecutor:
         eager_only = _has_host_aggs(plan)
         for _ in range(12):  # capacity-retry loop (jitted path)
             if eager_only:
-                out_page, required = _trace_plan(
-                    plan, inputs, caps, collect_stats=self.collect_operator_stats
-                )
+                with param_context(params):
+                    out_page, required = _trace_plan(
+                        plan, inputs, caps, collect_stats=self.collect_operator_stats
+                    )
                 required = {k: int(v) for k, v in required.items()}
             else:
-                out_page, required = self._run(plan, inputs, caps)
+                out_page, required = self._run(plan, inputs, caps, params)
             for key, val in required.items():
                 if isinstance(key, int) and key < 0 and int(val) > 1:
                     raise RuntimeError(
@@ -451,7 +460,7 @@ class LocalExecutor:
                 self.compile_wait_budget_ms = saved
             entry = self._jit_cache[cache_key]
         fn, _holder, _sig = entry
-        out, packed = fn(inputs)
+        out, packed = fn(inputs, ())
         jax.block_until_ready(packed)  # drain any pending work
         # keeping many dispatches in flight also keeps every run's OUTPUT
         # buffers alive at once; for queries whose working set is a big
@@ -465,7 +474,7 @@ class LocalExecutor:
 
         t0 = _time.perf_counter()
         for _ in range(iters):
-            _, packed = fn(inputs)
+            _, packed = fn(inputs, ())
         jax.block_until_ready(packed)
         return (_time.perf_counter() - t0) / iters
 
@@ -584,7 +593,10 @@ class LocalExecutor:
         self.last_execute_wall_ms = wall_ms
 
     def explain_analyze(
-        self, plan: PlanNode, remote_pages: Optional[dict[int, Page]] = None
+        self,
+        plan: PlanNode,
+        remote_pages: Optional[dict[int, Page]] = None,
+        params: tuple = (),
     ) -> tuple[Page, dict]:
         """Execute with per-operator observability (the reference's
         OperatorStats rolled up by ExplainAnalyzeOperator).
@@ -599,7 +611,7 @@ class LocalExecutor:
         import time
 
         # ensure capacities are learned + result correct (jitted path)
-        page = self.execute(plan, remote_pages)
+        page = self.execute(plan, remote_pages, params=params)
         caps = self._learned_caps[plan]
         nodes = _node_ids(plan)
         inputs = {}
@@ -620,19 +632,25 @@ class LocalExecutor:
             stats[nid] = {"ms": (now - last[0]) * 1e3}
             last[0] = now
 
-        _, required = _trace_plan(plan, inputs, caps, node_hook=hook, collect_stats=True)
+        with param_context(params):
+            _, required = _trace_plan(
+                plan, inputs, caps, node_hook=hook, collect_stats=True
+            )
         for key, val in required.items():
             if isinstance(key, int) and key >= _STATS_ROWS_BASE:
                 stats.setdefault(key - _STATS_ROWS_BASE, {})["rows"] = int(val)
         return page, stats
 
-    def _cache_key(self, plan: PlanNode, inputs: dict[str, Page], caps):
+    def _cache_key(self, plan: PlanNode, inputs: dict[str, Page], caps, params=()):
         """(jit-cache key, treedef, avals) for one (plan, inputs, caps).
         The AOT-compiled entry is pinned to one input pytree + avals
         (unlike a lazy jit, which retraces transparently), so the key
         must carry the full abstract structure: a None column where a
-        leaf used to be, or a reshaped dictionary, is a NEW program."""
-        leaves, treedef = jax.tree_util.tree_flatten(inputs)
+        leaf used to be, or a reshaped dictionary, is a NEW program.
+        Parameter VALUES never enter the key — only their avals (via the
+        flattened (inputs, params) pytree), so distinct bindings share one
+        program."""
+        leaves, treedef = jax.tree_util.tree_flatten((inputs, tuple(params)))
         avals = tuple(
             (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x).__name__)))
             for x in leaves
@@ -644,14 +662,21 @@ class LocalExecutor:
                treedef, avals, policy_key())
         return key, treedef, avals
 
-    def _run(self, plan: PlanNode, inputs: dict[str, Page], caps: dict[int, int]):
+    def _run(
+        self,
+        plan: PlanNode,
+        inputs: dict[str, Page],
+        caps: dict[int, int],
+        params: tuple = (),
+    ):
         import time as _time
 
         from ..utils.profiler import PROFILER, cost_summary, signature_of
         from .compilesvc import FALLBACKS, SERVICE
 
         collect = self.collect_operator_stats
-        cache_key, treedef, avals = self._cache_key(plan, inputs, caps)
+        params = tuple(params)
+        cache_key, treedef, avals = self._cache_key(plan, inputs, caps, params)
         _JIT_CACHE_LOOKUPS.labels(
             "hit" if cache_key in self._jit_cache else "miss"
         ).inc()
@@ -676,7 +701,7 @@ class LocalExecutor:
                 t0 = _time.perf_counter()
                 cost = None
                 try:
-                    fn = jitted.lower(inputs).compile()
+                    fn = jitted.lower(inputs, params).compile()
                     cost = cost_summary(fn)
                 except Exception:
                     # AOT unsupported for this program/backend: fall back
@@ -741,9 +766,10 @@ class LocalExecutor:
                 self.compile_events.append(event)
                 self.fallback_events.append(dict(event))
                 t0 = _time.perf_counter()
-                out_page, required = _trace_plan(
-                    plan, inputs, dict(caps), collect_stats=collect
-                )
+                with param_context(params):
+                    out_page, required = _trace_plan(
+                        plan, inputs, dict(caps), collect_stats=collect
+                    )
                 PROFILER.record_execute(
                     sig, _time.perf_counter() - t0, fallback=True
                 )
@@ -751,7 +777,7 @@ class LocalExecutor:
         fn, holder, sig = self._jit_cache[cache_key]
         t0 = _time.perf_counter()
         try:
-            out_page, packed = fn(inputs)
+            out_page, packed = fn(inputs, params)
         except TypeError:
             # AOT programs are pinned to one input pytree structure; a
             # structure drift the key missed (e.g. weak-type promotion)
@@ -762,7 +788,7 @@ class LocalExecutor:
             call, holder = _make_call(plan, dict(caps), collect)
             fn = jax.jit(call)
             self._jit_cache[cache_key] = (fn, holder, sig)
-            out_page, packed = fn(inputs)
+            out_page, packed = fn(inputs, params)
         vals = np.asarray(packed)  # ONE device->host transfer
         PROFILER.record_execute(sig, _time.perf_counter() - t0)
         required = dict(zip(holder["keys"], vals.tolist()))
@@ -779,8 +805,9 @@ def _make_call(plan: PlanNode, caps: dict[int, int], collect: bool):
     time in `holder` (deterministic per cache entry)."""
     holder: dict = {"keys": None}
 
-    def call(pages, _holder=holder):
-        out_page, req = _trace_plan(plan, pages, caps, collect_stats=collect)
+    def call(pages, params=(), _holder=holder):
+        with param_context(params):
+            out_page, req = _trace_plan(plan, pages, caps, collect_stats=collect)
         keys = sorted(req, key=repr)
         _holder["keys"] = keys
         packed = (

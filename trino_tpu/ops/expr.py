@@ -24,6 +24,8 @@ path, which is exactly what a TPU wants (no varlen bytes in HBM).
 from __future__ import annotations
 
 import re
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -32,9 +34,35 @@ import numpy as np
 
 from ..data.page import Column, Dictionary, Page
 from ..data.types import BOOLEAN, DATE, DOUBLE, Type, UNKNOWN, VARCHAR
-from ..plan.ir import Call, CaseWhen, Const, FieldRef, InListIr, IrExpr, LikeIr
+from ..plan.ir import Call, CaseWhen, Const, FieldRef, InListIr, IrExpr, LikeIr, Param
 
-__all__ = ["ColumnVal", "eval_expr", "eval_predicate", "column_val", "to_column"]
+__all__ = [
+    "ColumnVal", "eval_expr", "eval_predicate", "column_val", "to_column",
+    "param_context",
+]
+
+
+class _ParamContext(threading.local):
+    """Prepared-statement parameter values live here during a plan trace
+    (exec/compiler.py pushes around _trace_plan).  Inside jit the values are
+    tracers — ir.Param evaluates to a runtime scalar broadcast, never a
+    trace-time constant, so one compiled program serves every binding."""
+
+    def __init__(self):
+        self.values = ()
+
+
+_PARAMS = _ParamContext()
+
+
+@contextmanager
+def param_context(values):
+    prev = _PARAMS.values
+    _PARAMS.values = tuple(values) if values is not None else ()
+    try:
+        yield
+    finally:
+        _PARAMS.values = prev
 
 
 @dataclass
@@ -77,6 +105,8 @@ def eval_expr(e: IrExpr, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         return cols[e.index]
     if isinstance(e, Const):
         return _const_val(e, n)
+    if isinstance(e, Param):
+        return _param_val(e, n)
     if isinstance(e, Call):
         return _call(e, cols, n)
     if isinstance(e, CaseWhen):
@@ -144,6 +174,17 @@ def _const_val(e: Const, n: int) -> ColumnVal:
     return ColumnVal(
         jnp.full((n,), e.value, dtype=_np_to_jnp(e.type)), None, None, e.type
     )
+
+
+def _param_val(e: Param, n: int) -> ColumnVal:
+    values = _PARAMS.values
+    if e.index >= len(values):
+        raise NotImplementedError(
+            f"parameter ${e.index} evaluated outside a binding context"
+        )
+    dt = jnp.bool_ if e.type == BOOLEAN else _np_to_jnp(e.type)
+    scalar = jnp.asarray(values[e.index]).astype(dt)
+    return ColumnVal(jnp.broadcast_to(scalar, (n,)), None, None, e.type)
 
 
 def _np_to_jnp(t: Type):

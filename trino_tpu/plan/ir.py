@@ -18,8 +18,8 @@ from typing import Optional, Sequence
 from ..data.types import BOOLEAN, Type
 
 __all__ = [
-    "IrExpr", "FieldRef", "Const", "Call", "CaseWhen", "InListIr", "LikeIr",
-    "LambdaIr", "LambdaVarIr", "field_refs",
+    "IrExpr", "FieldRef", "Const", "Param", "Call", "CaseWhen", "InListIr",
+    "LikeIr", "LambdaIr", "LambdaVarIr", "field_refs",
 ]
 
 
@@ -46,6 +46,21 @@ class Const(IrExpr):
 
     def __str__(self) -> str:
         return repr(self.value)
+
+
+@dataclass(frozen=True)
+class Param(IrExpr):
+    """A bound prepared-statement parameter evaluated as a *runtime* scalar
+    (a jit argument), not a trace-time constant — so every execution of one
+    prepared plan shares a single compiled program (reference: EXECUTE with
+    Parameter bound at analysis, sql/analyzer).  The value is supplied via
+    ops/expr.py's parameter context at trace time."""
+
+    index: int
+    type: Type
+
+    def __str__(self) -> str:
+        return f"$?{self.index}"
 
 
 @dataclass(frozen=True)

@@ -28,6 +28,8 @@ sql/planner/iterative/rule/ this engine needs) into one pass:
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
@@ -38,13 +40,37 @@ from ..data.types import (
 )
 from ..sql import ast as A
 from ..sql.parser import parse
-from .ir import Call, CaseWhen, Const, FieldRef, InListIr, IrExpr, LikeIr
+from .ir import Call, CaseWhen, Const, FieldRef, InListIr, IrExpr, LikeIr, Param
 from .nodes import (
     AggCall, Aggregate, Distinct, Filter, Join, Limit, PlanNode, Project,
     Sort, SortKey, TableScan, TopN, Unnest,
 )
 
-__all__ = ["Planner", "PlanningError"]
+__all__ = ["Planner", "PlanningError", "param_bindings"]
+
+
+class _ParamBindings(threading.local):
+    """Per-thread parameter binding context for planning a prepared-statement
+    template (runtime/fastpath.py).  Each slot is ("bind", type, value) —
+    translate to a runtime ir.Param — or ("bake", type, value) — translate to
+    a plan constant (the generic-vs-custom-plan split: value-dependent
+    lowerings like dictionary string ops must see the concrete value)."""
+
+    def __init__(self):
+        self.slots = None
+
+
+_PARAM_BINDINGS = _ParamBindings()
+
+
+@contextmanager
+def param_bindings(slots):
+    prev = _PARAM_BINDINGS.slots
+    _PARAM_BINDINGS.slots = slots
+    try:
+        yield
+    finally:
+        _PARAM_BINDINGS.slots = prev
 
 
 class PlanningError(Exception):
@@ -1924,6 +1950,14 @@ class _Translator:
             if self.grouped:
                 raise PlanningError(f"column {e} must appear in GROUP BY")
             return FieldRef(idx, t)
+        if isinstance(e, A.Parameter):
+            slots = _PARAM_BINDINGS.slots
+            if slots is None or e.index >= len(slots):
+                raise PlanningError(f"parameter ${e.index} has no binding")
+            mode, typ, value = slots[e.index]
+            if mode == "bind":
+                return Param(e.index, typ)
+            return Const(value, typ)
         if isinstance(e, A.IntLit):
             return Const(e.value, BIGINT)
         if isinstance(e, A.FloatLit):

@@ -27,6 +27,7 @@ import time
 import traceback
 import urllib.request
 import uuid
+from urllib.parse import unquote
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -713,11 +714,19 @@ class Coordinator:
             raise RuntimeError(sm.error)
         return record["result"]
 
-    def submit_query(self, sql: str, spooled: bool = False) -> str:
+    def submit_query(
+        self, sql: str, spooled: bool = False,
+        prepared: Optional[dict] = None,
+    ) -> str:
         """Admission-controlled submit (reference: DispatchManager.createQuery
         queueing through resource groups before SqlQueryExecution starts).
         The query's declared memory budget counts against its group while it
-        runs; a full queue rejects immediately."""
+        runs; a full queue rejects immediately.
+
+        `prepared` is the client's statement registry from its
+        X-Trino-Prepared-Statement headers (name -> SQL text): EXECUTE
+        resolves against it before falling back to server-side PREPAREs, so
+        stateless clients can replay their registry on every request."""
         from .resourcegroups import QueryRejected
 
         qid = f"q_{uuid.uuid4().hex[:12]}"
@@ -726,6 +735,7 @@ class Coordinator:
             "sm": sm, "sql": sql, "result": None, "columns": None,
             "done": threading.Event(),
             "spooled": spooled and bool(self.session.get("client_spool_dir")),
+            "prepared": prepared,
         }
         with self._lock:
             self.queries[qid] = record
@@ -954,11 +964,27 @@ class Coordinator:
                     sm.transition("RUNNING")
                     if record.get("cancel"):
                         raise RuntimeError("Query was canceled")
-                    rows = _statement_surface(self).execute_stmt(stmt)
+                    surface = _statement_surface(self)
+                    rows = surface.execute_stmt(
+                        stmt, prepared=record.get("prepared")
+                    )
                     record["result"] = rows
                     record["columns"] = (
                         [f"col{i}" for i in range(len(rows[0]))] if rows else ["result"]
                     )
+                    if isinstance(stmt, S.ExecuteStmt):
+                        # the fast path knows the plan's real output names;
+                        # without it EXECUTE results degrade to col0..colN
+                        fp = getattr(surface, "_fastpath", None)
+                        if fp is not None and fp.last_columns:
+                            record["columns"] = list(fp.last_columns)
+                    elif isinstance(stmt, S.Prepare):
+                        # protocol echo (reference: Trino's added-prepare
+                        # response header): the client mirrors this into its
+                        # own registry and replays it on later requests
+                        record["addedPrepare"] = {stmt.name: stmt.sql}
+                    elif isinstance(stmt, S.Deallocate):
+                        record["deallocatedPrepare"] = [stmt.name]
                     sm.transition("FINISHED")
                 except Exception as e:
                     traceback.print_exc()
@@ -2358,7 +2384,20 @@ def _make_handler(coord: Coordinator):
                         )
                 sql = body.decode()
                 spooled = self.headers.get("X-Trino-Spooled") == "1"
-                qid = coord.submit_query(sql, spooled=spooled)
+                # client-held prepared registry (reference: Trino's
+                # X-Trino-Prepared-Statement request header): each value is
+                # "name=<urlencoded sql>", comma-separated when several ride
+                # one header line; the header itself may also repeat
+                prepared = None
+                for hv in self.headers.get_all("X-Trino-Prepared-Statement") or ():
+                    for item in hv.split(","):
+                        name, sep, enc = item.strip().partition("=")
+                        if not sep or not name:
+                            continue
+                        if prepared is None:
+                            prepared = {}
+                        prepared[unquote(name)] = unquote(enc)
+                qid = coord.submit_query(sql, spooled=spooled, prepared=prepared)
                 return self._send_json(
                     200,
                     {"id": qid, "nextUri": f"{coord.url}/v1/statement/{qid}/0"},
@@ -2643,15 +2682,19 @@ def _make_handler(coord: Coordinator):
                             ],
                         },
                     )
-                return self._send_json(
-                    200,
-                    {
-                        "id": qid,
-                        "stats": {"state": sm.state},
-                        "columns": record["columns"],
-                        "data": [list(r) for r in record["result"]],
-                    },
-                )
+                final = {
+                    "id": qid,
+                    "stats": {"state": sm.state},
+                    "columns": record["columns"],
+                    "data": [list(r) for r in record["result"]],
+                }
+                # prepared-registry deltas ride the terminal response so the
+                # client can mirror server-side PREPARE / DEALLOCATE into the
+                # registry it replays on subsequent requests
+                for k in ("addedPrepare", "deallocatedPrepare"):
+                    if record.get(k):
+                        final[k] = record[k]
+                return self._send_json(200, final)
             if parts[:2] == ["v1", "spooled"] and len(parts) >= 4:
                 if not parts[3].isdigit():
                     return self._send_json(404, {"error": "no such segment"})

@@ -310,7 +310,21 @@ class Engine:
 
         return self.execute_stmt(S.parse_statement(sql))
 
-    def execute_stmt(self, stmt) -> list[tuple]:
+    def fastpath(self):
+        """Lazy per-surface prepared-statement fast path (runtime/
+        fastpath.py): parameterized plan cache + pipelined/batched
+        dispatch.  Shared by every protocol session of a coordinator."""
+        fp = getattr(self, "_fastpath", None)
+        if fp is None:
+            from .fastpath import FastPath
+
+            fp = self._fastpath = FastPath(self)
+        return fp
+
+    def execute_stmt(self, stmt, prepared: Optional[dict] = None) -> list[tuple]:
+        """`prepared`: client-held prepared-statement overlay (name -> sql,
+        from X-Trino-Prepared-Statement headers) consulted before the
+        engine's own session registry."""
         from ..sql import statements as S
 
         # access control at statement dispatch (reference: AccessControl
@@ -339,7 +353,7 @@ class Engine:
             return self.query(stmt.query)
 
         if isinstance(stmt, S.Explain):
-            return self._execute_explain(stmt)
+            return self._execute_explain(stmt, prepared)
 
         if isinstance(stmt, S.CreateTable):
             from ..data.types import parse_type
@@ -483,11 +497,16 @@ class Engine:
             return [(1,)]
 
         if isinstance(stmt, S.ExecuteStmt):
-            if stmt.name not in self._prepared:
-                raise KeyError(f"prepared statement not found: {stmt.name}")
-            bound = S.parse_statement(
-                self._prepared[stmt.name], params=stmt.parameters
-            )
+            sql_text = self._resolve_prepared(stmt.name, prepared)
+            from .fastpath import NotFastpath
+
+            try:
+                return self.fastpath().execute(sql_text, stmt.parameters)
+            except NotFastpath:
+                pass
+            # legacy path: typed AST substitution + full replan (DML
+            # templates, expression parameters, fast path disabled)
+            bound = S.parse_statement(sql_text, params=stmt.parameters)
             return self.execute_stmt(bound)
 
         if isinstance(stmt, S.Deallocate):
@@ -538,7 +557,53 @@ class Engine:
         engine has none and uses the executor path in _execute_explain."""
         return None
 
-    def _execute_explain(self, stmt) -> list[tuple]:
+    def _explain_execute(self, stmt, prepared: Optional[dict] = None) -> list[tuple]:
+        """EXPLAIN [ANALYZE] EXECUTE name [USING ...]: the prepared fast
+        path's plan plus a `-- fastpath:` footer with the plan-cache
+        disposition (hit|miss|bypass) and binding split."""
+        from ..sql import statements as S
+        from .fastpath import NotFastpath
+
+        ex_stmt = stmt.execute
+        sql_text = self._resolve_prepared(ex_stmt.name, prepared)
+        fp = self.fastpath()
+        t0 = _time.perf_counter()
+        try:
+            tmpl, n_params = fp._template(sql_text)
+            if len(ex_stmt.parameters) != n_params:
+                raise ValueError(
+                    f"prepared statement takes {n_params} parameters,"
+                    f" got {len(ex_stmt.parameters)}"
+                )
+            slots = fp._slots(ex_stmt.parameters)
+            entry = fp._lookup(sql_text, tmpl.query, slots)
+        except NotFastpath:
+            bound = S.parse_statement(sql_text, params=ex_stmt.parameters)
+            if not isinstance(bound, S.QueryStmt):
+                raise ValueError("EXPLAIN EXECUTE requires a query template")
+            inner = S.Explain(bound.query, stmt.analyze, stmt.distributed)
+            text = [r[0] for r in self._execute_explain(inner)]
+            text.append("-- fastpath: off (legacy substitute-and-replan path)")
+            return [(line,) for line in text]
+        info = fp.last_info
+        text = format_plan(entry.plan).splitlines()
+        if stmt.analyze:
+            params = fp._param_values(entry.slots, slots)
+            self._apply_compile_props()
+            page = fp._executor().execute(entry.plan, params=params)
+            rows = page.to_pylist()
+            wall = _time.perf_counter() - t0
+            text.append(
+                f"-- output rows: {len(rows)}, wall: {wall * 1e3:.1f} ms"
+            )
+        window = float(self.session.get("execute_batch_window_ms") or 0.0)
+        text.append(
+            f"-- fastpath: plan_cache={info.cache} bound={info.bound}"
+            f" baked={info.baked} batch_window_ms={window:g} executor=local"
+        )
+        return [(line,) for line in text]
+
+    def _execute_explain(self, stmt, prepared: Optional[dict] = None) -> list[tuple]:
         """EXPLAIN [ANALYZE] in the session's explain_format (text | json).
         ANALYZE prefers the distributed QueryInfo; otherwise any executor
         with eager per-operator timing (LocalExecutor, SpmdExecutor)."""
@@ -546,6 +611,8 @@ class Engine:
 
         from ..plan.nodes import plan_to_obj
 
+        if stmt.execute is not None:
+            return self._explain_execute(stmt, prepared)
         fmt = str(self.session.get("explain_format") or "text").lower()
         plan = self.plan(stmt.query)
         if not stmt.analyze:
@@ -784,7 +851,8 @@ class Engine:
         cached result can never survive DML on a table it read."""
         cache = getattr(self, "result_cache", None)
         memo = getattr(self, "fragment_memo", None)
-        if cache is None and memo is None:
+        fp = getattr(self, "_fastpath", None)
+        if cache is None and memo is None and fp is None:
             return
         try:
             _, catalog, table = self._target_ref(name)
@@ -795,6 +863,17 @@ class Engine:
             cache.invalidate_table(catalog, table)
         if memo is not None:
             memo.invalidate_table(catalog, table)
+        if fp is not None:
+            fp.invalidate_table(catalog, table)
+
+    def _resolve_prepared(self, name: str, prepared: Optional[dict] = None) -> str:
+        """Prepared-statement lookup: the client-held overlay (protocol
+        headers) wins over the engine's session registry."""
+        if prepared and name in prepared:
+            return prepared[name]
+        if name not in self._prepared:
+            raise KeyError(f"prepared statement not found: {name}")
+        return self._prepared[name]
 
     def _target_conn(self, name: str):
         """Resolve a possibly `catalog.table`-qualified DDL/DML target

@@ -285,6 +285,41 @@ PROPERTIES: dict[str, _Prop] = {
             "CI path: same kernel code, no Mosaic compile)",
             None,
         ),
+        _Prop(
+            "prepared_fastpath_enabled", bool, True,
+            "serve EXECUTE of a prepared SELECT through the parameterized "
+            "fast path (runtime/fastpath.py): parameters bound as jit "
+            "arguments into one canonical compiled plan instead of "
+            "re-parsing/re-planning per literal (reference: EXECUTE with "
+            "session-held prepared statements); off = the legacy "
+            "substitute-and-replan path",
+            None,
+        ),
+        _Prop(
+            "plan_cache_enabled", bool, True,
+            "kill switch for the ParameterizedPlanCache: off = every "
+            "EXECUTE replans (still binding parameters as jit arguments); "
+            "cache entries are pinned to the scanned tables' version "
+            "vector and invalidated on DML/snapshot bumps like "
+            "runtime/resultcache.py",
+            None,
+        ),
+        _Prop(
+            "plan_cache_max_entries", int, 64,
+            "LRU capacity of the parameterized plan cache (per engine "
+            "surface); evictions count in "
+            "trino_tpu_plan_cache_events_total{event=\"evicted\"}",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "execute_batch_window_ms", float, 0.0,
+            "shared small-query batching: concurrent EXECUTEs of the SAME "
+            "prepared plan arriving within this window are stacked into "
+            "one batched device dispatch (parameters become a leading "
+            "batch axis when the plan supports vmap, per-query pipelined "
+            "dispatch otherwise); 0 disables batching",
+            lambda v: v >= 0,
+        ),
     ]
 }
 

@@ -82,6 +82,16 @@ class IntervalLit(Expr):
 
 
 @dataclass(frozen=True)
+class Parameter(Expr):
+    """A deferred `?` placeholder (reference: sql/tree/Parameter).  Produced
+    by the parser's "defer" params mode so a prepared statement's template
+    AST carries positional placeholders instead of spliced literals; the
+    planner binds them per EXECUTE (runtime/fastpath.py)."""
+
+    index: int
+
+
+@dataclass(frozen=True)
 class BinOp(Expr):
     op: str  # + - * / % = <> < <= > >= and or
     left: Expr

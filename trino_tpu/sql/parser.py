@@ -18,7 +18,7 @@ from typing import Optional
 from .ast import (
     Between, BinOp, BoolLit, CaseExpr, Cast, DateLit, DecimalLit, Exists, Expr,
     Extract, FloatLit, FuncCall, Ident, InList, InSubquery, IntLit, IntervalLit, IsNull,
-    JoinRelation, Like, Neg, Not, NullLit, Query, Relation, ScalarSubquery,
+    JoinRelation, Like, Neg, Not, NullLit, Parameter, Query, Relation, ScalarSubquery,
     Select, SelectItem, SortItem, Star, StrLit, SubqueryRelation, Table,
 )
 from .lexer import SqlSyntaxError, Token, tokenize
@@ -46,8 +46,10 @@ class _Parser:
         self.tokens = tokens
         self.i = 0
         # prepared-statement parameters: None outside EXECUTE (a '?' is then a
-        # syntax error), "probe" during PREPARE validation ('?' -> NULL), or
-        # the ordered list of literal Exprs bound by EXECUTE ... USING
+        # syntax error), "probe" during PREPARE validation ('?' -> NULL),
+        # "defer" to keep positional Parameter placeholders in the AST (the
+        # fast-path template parse, runtime/fastpath.py), or the ordered list
+        # of literal Exprs bound by EXECUTE ... USING
         self.params = None
         self.param_i = 0
 
@@ -742,6 +744,10 @@ class _Parser:
                 raise SqlSyntaxError(f"parameter '?' outside PREPARE/EXECUTE at {t.pos}")
             if self.params == "probe":
                 return NullLit()
+            if self.params == "defer":
+                e = Parameter(self.param_i)
+                self.param_i += 1
+                return e
             if self.param_i >= len(self.params):
                 raise SqlSyntaxError(
                     f"too few parameters: statement needs more than {len(self.params)}"

@@ -24,6 +24,7 @@ __all__ = [
     "InsertValues", "Delete", "Update", "Merge", "MergeClause",
     "Prepare", "ExecuteStmt", "Deallocate",
     "StartTransaction", "Commit", "Rollback", "parse_statement",
+    "parse_template",
 ]
 
 
@@ -96,9 +97,14 @@ class ShowCreateView(Statement):
 
 @dataclass(frozen=True)
 class Explain(Statement):
-    query: Query
+    """EXPLAIN [ANALYZE] query | EXECUTE name [USING ...].  For EXPLAIN
+    EXECUTE (reference: sql/tree/Explain wrapping Execute) `query` is None
+    and `execute` carries the prepared-statement invocation."""
+
+    query: Optional[Query]
     analyze: bool = False
     distributed: bool = False
+    execute: Optional["ExecuteStmt"] = None
 
 
 @dataclass(frozen=True)
@@ -208,6 +214,19 @@ def parse_statement(sql: str, params=None) -> Statement:
     return stmt
 
 
+def parse_template(sql: str) -> tuple[Statement, int]:
+    """Parse a prepared statement's body keeping `?` placeholders as
+    positional `ast.Parameter` nodes (the reference keeps the parsed
+    Statement with sql/tree/Parameter in the session).  Returns the template
+    statement and the number of parameters it takes."""
+    p = _Parser(tokenize(sql))
+    p.params = "defer"
+    stmt = _parse_statement(p, sql)
+    p.accept_op(";")
+    p.expect_eof()
+    return stmt, p.param_i
+
+
 def _parse_statement(p: "_Parser", sql: str = "") -> Statement:
     if p.peek_kw("SELECT", "WITH"):
         return QueryStmt(p.parse_query())
@@ -222,6 +241,15 @@ def _parse_statement(p: "_Parser", sql: str = "") -> Statement:
                     p.accept_kw("LOGICAL")
                 else:
                     p.i += 1
+        if p.accept_kw("EXECUTE"):
+            name = p.ident()
+            params = []
+            if p.accept_kw("USING"):
+                while True:
+                    params.append(p.parse_expr())
+                    if not p.accept_op(","):
+                        break
+            return Explain(None, analyze, distributed, ExecuteStmt(name, tuple(params)))
         return Explain(p.parse_query(), analyze, distributed)
 
     if p.accept_kw("CREATE"):
