@@ -319,6 +319,137 @@ def _bench_concurrency(deadline) -> dict:
         runner.stop()
 
 
+def _bench_fleet(deadline) -> dict:
+    """Coordinator-fleet scaling (runtime/fleet.py): the same concurrent
+    load through a 1- then 2-coordinator fleet behind the shard router.
+
+    What a second coordinator buys is SERVING CAPACITY — concurrent
+    queries in flight — so the workload is shaped the way fleet scaling
+    matters in practice: queries are I/O-bound (the connector simulates
+    BENCH_FLEET_IO_DELAY_S of remote-storage latency per scan, the
+    dominant term for warehouse scans) and each member's admission plane
+    is capped at BENCH_FLEET_CONC_PER_COORD running queries, the
+    resource-group limit a real deployment sizes per coordinator.  QPS is
+    then N*cap/latency: it doubles with the member count, and the bench
+    verifies the fleet plane (router sharding, leases, shared admission)
+    delivers that instead of serializing.  CPU-bound scaling is NOT
+    measurable here — bench hosts are single-core, and in-process members
+    share one GIL — which is exactly why the load is latency-bound.
+    Reports the per-coordinator QPS split at each N plus the 1->2
+    speedup."""
+    import threading
+
+    import numpy as np
+
+    from trino_tpu.client import StatementClient
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnSchema
+    from trino_tpu.data.types import BIGINT
+    from trino_tpu.runtime.resourcegroups import (
+        ResourceGroupConfig,
+        ResourceGroupManager,
+    )
+    from trino_tpu.testing import DistributedQueryRunner
+
+    clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_FLEET_QUERIES", "4"))
+    cap = int(os.environ.get("BENCH_FLEET_CONC_PER_COORD", "2"))
+    io_delay = float(os.environ.get("BENCH_FLEET_IO_DELAY_S", "0.8"))
+    sql = "select count(*), sum(v) from t"
+
+    class _SlowScanConnector(MemoryConnector):
+        def read_split(self, split, columns):
+            time.sleep(io_delay)
+            return super().read_split(split, columns)
+
+    def run_n(n: int) -> dict:
+        conn = _SlowScanConnector()
+        conn.create_table(
+            "t", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)]
+        )
+        conn.insert("t", {
+            "k": np.arange(64, dtype=np.int64),
+            "v": np.arange(64, dtype=np.int64) * 3,
+        })
+        runner = DistributedQueryRunner(
+            num_workers=2, default_catalog="memory", num_coordinators=n
+        )
+        runner.register_catalog("memory", conn)
+        runner.start()
+        try:
+            for c in runner.coordinators:
+                c.session.set("result_cache_enabled", "false")
+                c.execute_query(sql)  # warm: compile outside the window
+            for c in runner.coordinators:
+                c.resource_groups = ResourceGroupManager(
+                    ResourceGroupConfig(max_concurrency=cap)
+                )
+            before = [len(c.queries) for c in runner.coordinators]
+            lats: list[float] = []
+            errors = [0]
+            lock = threading.Lock()
+
+            def one_client(ci: int):
+                c = StatementClient(runner.client_url)
+                for _ in range(per_client):
+                    t0 = time.perf_counter()
+                    try:
+                        c.execute(sql, timeout=180)
+                    except Exception:
+                        with lock:
+                            errors[0] += 1
+                    else:
+                        with lock:
+                            lats.append(time.perf_counter() - t0)
+
+            threads = [
+                threading.Thread(target=one_client, args=(ci,), daemon=True)
+                for ci in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            wall = time.perf_counter() - t0
+            lats.sort()
+            per_coord = {}
+            for i, c in enumerate(runner.coordinators):
+                served = len(c.queries) - before[i]
+                per_coord[f"c{i}"] = {
+                    "queries": served,
+                    "qps": round(served / wall, 2),
+                }
+            return {
+                "completed": len(lats),
+                "errors": errors[0],
+                "wall_s": round(wall, 2),
+                "qps": round(len(lats) / wall, 2),
+                "p50_ms": (
+                    round(lats[len(lats) // 2] * 1e3, 1) if lats else None
+                ),
+                "per_coordinator": per_coord,
+            }
+        finally:
+            runner.stop()
+
+    out: dict = {
+        "clients": clients,
+        "queries_per_client": per_client,
+        "conc_per_coordinator": cap,
+        "io_delay_s": io_delay,
+        "sql": sql,
+    }
+    out["n1"] = run_n(1)
+    if deadline.remaining() > 60:
+        out["n2"] = run_n(2)
+        if out["n1"].get("qps") and out["n2"].get("qps"):
+            out["qps_speedup_1_to_2"] = round(
+                out["n2"]["qps"] / out["n1"]["qps"], 2
+            )
+    return out
+
+
 def _bench_prepared(deadline) -> dict:
     """Serving fast path (runtime/fastpath.py): PREPARE once, EXECUTE with a
     different parameter every time, against the same workload issued the old
@@ -678,6 +809,14 @@ def main() -> None:
         except Exception as e:
             result["concurrency"] = {"error": str(e)[:200]}
         emit()
+        # fleet: per-coordinator QPS split at N=1 vs N=2 through the
+        # shard router (ISSUE 13)
+        if os.environ.get("BENCH_FLEET", "1") != "0" and deadline.remaining() > 90:
+            try:
+                result["concurrency"]["fleet"] = _bench_fleet(deadline)
+            except Exception as e:
+                result["concurrency"]["fleet"] = {"error": str(e)[:200]}
+            emit()
 
     # ---- serving fast path: PREPARE/EXECUTE vs ad-hoc text (ISSUE 10) ----
     if os.environ.get("BENCH_CONC_PREPARED", "0") == "1" and deadline.remaining() > 60:

@@ -23,6 +23,12 @@
 #   cache   hot cached query under DML + worker kill + coordinator
 #           restart — typed invalidation and the cold-restart contract
 #           mean no step may ever return a stale row
+# Coordinator-fleet chaos (tests/test_fleet.py):
+#   fleet   kill one coordinator of a two-member fleet mid multi-stage
+#           query — a peer adopts it off the dead member's journal
+#           (spool-committed stages re-read, zero recompute) and the
+#           client rides through the router with zero visible failures;
+#           plus lease lifecycle, GC mutual exclusion, shard stability
 # No subcommand runs the full seeded chaos schedule suite (-m chaos).
 #
 # Not part of the tier-1 gate (marked slow); run it before touching the
@@ -60,6 +66,11 @@ case "${1:-}" in
   coordinator)
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_recovery.py -q \
+        -p no:cacheprovider "$@"
+    ;;
+  fleet)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
         -p no:cacheprovider "$@"
     ;;
   cache)

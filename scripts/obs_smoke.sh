@@ -167,7 +167,126 @@ try:
     after = _kernels._DISPATCH.value("group_by", "pallas")
     assert after > before, "kernel dispatch counter did not move"
     print(f"kernel dispatch: {klines[0]} (counter {before:.0f} -> {after:.0f})")
-    print("OBS_SMOKE_OK")
 finally:
     runner.stop()
+
+# ---------------------------------------------------------------- fleet plane
+# two-coordinator fleet behind the router: kill the query's owner mid-flight
+# and assert the failover observability — a nonzero
+# trino_tpu_fleet_adoptions_total on the survivor and the `-- fleet:` footer
+# on the adopted EXPLAIN ANALYZE (runtime/fleet.py)
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from trino_tpu.client import StatementClient
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import ColumnSchema
+from trino_tpu.data.types import BIGINT
+
+
+class GatedMemoryConnector(MemoryConnector):
+    def __init__(self):
+        super().__init__()
+        self.gate = threading.Event()
+        self.gated_table = None
+
+    def read_split(self, split, columns):
+        if split.table == self.gated_table:
+            assert self.gate.wait(timeout=120), "gate never opened"
+        return super().read_split(split, columns)
+
+
+conn = GatedMemoryConnector()
+conn.create_table("build", [ColumnSchema("k", BIGINT), ColumnSchema("w", BIGINT)])
+conn.insert("build", {"k": np.arange(50, dtype=np.int64),
+                      "w": np.arange(50, dtype=np.int64) * 10})
+conn.create_table("probe", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)])
+conn.insert("probe", {"k": np.arange(2000, dtype=np.int64) % 50,
+                      "v": np.arange(2000, dtype=np.int64)})
+
+spool = tempfile.mkdtemp(prefix="obs_fleet_spool_")
+fleet = DistributedQueryRunner(
+    num_workers=2, default_catalog="memory", heartbeat_interval=0.3,
+    num_coordinators=2, fleet_ttl_s=1.5,
+)
+fleet.register_catalog("memory", conn)
+fleet.start()
+try:
+    for c in fleet.coordinators:
+        c.session.set("retry_policy", "TASK")
+        c.session.set("exchange_spool_dir", spool)
+        c.session.set("resume_policy", "RESUME")
+
+    FLEET_SQL = ("explain analyze select sum(v + w) from probe, build "
+                 "where probe.k = build.k")
+    conn.gated_table = "probe"
+
+    class _Rider(threading.Thread):
+        def __init__(self):
+            super().__init__(daemon=True)
+            self.client = StatementClient(fleet.client_url,
+                                          reattach_max_elapsed_s=90.0)
+            self.result = None
+            self.error = None
+
+        def run(self):
+            try:
+                self.result = self.client.execute(FLEET_SQL, timeout=120)
+            except Exception as e:
+                self.error = e
+
+    rider = _Rider()
+    rider.start()
+    deadline = time.monotonic() + 60
+    committed = lambda: any(
+        os.path.exists(os.path.join(spool, n, "COMMITTED"))
+        for n in (os.listdir(spool) if os.path.isdir(spool) else [])
+    )
+    while time.monotonic() < deadline and not committed():
+        time.sleep(0.05)
+    assert committed(), "build stage never spool-committed"
+
+    owner = None
+    for i, c in enumerate(fleet.coordinators):
+        with c._lock:
+            if any(not rec["done"].is_set() for rec in c.queries.values()):
+                owner = i
+    assert owner is not None, "no coordinator owns the in-flight query"
+    fleet.kill_coordinator(owner)
+    conn.gate.set()
+    rider.join(timeout=120)
+    assert rider.error is None, f"client saw a failure: {rider.error!r}"
+
+    ftext = "\n".join(row[0] for row in rider.result[1])
+    flt_lines = [ln for ln in ftext.splitlines() if ln.startswith("-- fleet:")]
+    assert flt_lines and "adopted from" in flt_lines[0], (
+        f"expected a fleet adoption footer:\n{ftext[-800:]}"
+    )
+    print(f"fleet: {flt_lines[0]}")
+
+    survivor = fleet.coordinators[1 - owner]
+    smtext = get(survivor.url + "/metrics")
+    ad = [ln for ln in smtext.splitlines()
+          if ln.startswith("trino_tpu_fleet_adoptions_total")
+          and not ln.startswith("#")]
+    assert ad and float(ad[0].split()[-1]) >= 1, (
+        f"expected a nonzero adoption counter: {ad}"
+    )
+    assert 'trino_tpu_fleet_lease_transitions_total{event="expire"}' in smtext
+    print(f"fleet adoptions counter: {ad[0].split()[-1]}")
+
+    sinfo = json.loads(get(survivor.url + "/v1/info"))
+    assert sinfo.get("fleet", {}).get("members"), "fleet info missing members"
+    ui = get(survivor.url + "/ui")
+    assert "origin" in ui, "/ui missing the fleet origin column"
+    print(f"fleet /v1/info + /ui: "
+          f"{len(sinfo['fleet']['members'])} members listed ok")
+    print("OBS_SMOKE_OK")
+finally:
+    conn.gate.set()
+    fleet.stop()
 EOF

@@ -8,8 +8,8 @@ from __future__ import annotations
 import json
 import time
 import urllib.request
-from typing import Optional
-from urllib.parse import quote
+from typing import Optional, Sequence, Union
+from urllib.parse import quote, urlsplit
 
 __all__ = ["StatementClient", "QueryFailed"]
 
@@ -22,7 +22,8 @@ class QueryFailed(Exception):
 
 class StatementClient:
     def __init__(
-        self, server_url: str, poll_interval: float = 0.05,
+        self, server_url: Union[str, Sequence[str]],
+        poll_interval: float = 0.05,
         spooled: bool = False, shed_retries: int = 0,
         reattach: bool = True, reattach_max_elapsed_s: float = 30.0,
     ):
@@ -37,11 +38,24 @@ class StatementClient:
         TOO_MANY_REQUESTS backpressure instead of failing outright).
 
         reattach=True (default) rides nextUri polls through coordinator
-        death: connection errors retry with jittered exponential backoff
+        death: connection errors retry with decorrelated-jitter backoff
         for up to reattach_max_elapsed_s — a journaled coordinator restart
         resumes the query under the same id on the same port, so the poll
-        that finally lands gets the live state, not a dead socket."""
-        self.server_url = server_url.rstrip("/")
+        that finally lands gets the live state, not a dead socket.
+
+        server_url may be a LIST of endpoints (a coordinator fleet): the
+        first is preferred for submission, and a connection-refused —
+        submitting OR re-attaching — fails over to the others instead of
+        retrying one dead host until reattach_max_elapsed_s expires.  A
+        query adopted by a surviving coordinator answers the same
+        /v1/statement/{qid}/... path there, so the failed-over poll lands
+        on the live copy."""
+        if isinstance(server_url, str):
+            endpoints = [server_url]
+        else:
+            endpoints = list(server_url) or [""]
+        self.endpoints = [u.rstrip("/") for u in endpoints]
+        self.server_url = self.endpoints[0]
         self.poll_interval = poll_interval
         self.spooled = spooled
         self.shed_retries = shed_retries
@@ -53,28 +67,41 @@ class StatementClient:
         # response's addedPrepare / deallocatedPrepare deltas, so EXECUTE
         # works against a stateless (or restarted) coordinator
         self.prepared: dict[str, str] = {}
+        self.last_query_id: Optional[str] = None
 
     def _post_statement(self, sql: str, headers: dict) -> dict:
-        """POST /v1/statement, honoring 429 + Retry-After backpressure."""
+        """POST /v1/statement, honoring 429 + Retry-After backpressure.
+        With multiple endpoints, connection-refused fails over to the next
+        one (HTTP verdicts — 429, 4xx, 5xx — do NOT fail over: the
+        coordinator answered)."""
         attempt = 0
         while True:
-            req = urllib.request.Request(
-                f"{self.server_url}/v1/statement", data=sql.encode(),
-                headers=headers,
-            )
-            try:
-                with urllib.request.urlopen(req, timeout=30) as r:
-                    return json.loads(r.read())
-            except urllib.error.HTTPError as e:
-                if e.code != 429 or attempt >= self.shed_retries:
-                    raise
-                attempt += 1
+            last_err: Optional[OSError] = None
+            for base in self.endpoints:
+                req = urllib.request.Request(
+                    f"{base}/v1/statement", data=sql.encode(),
+                    headers=headers,
+                )
                 try:
-                    delay = float(e.headers.get("Retry-After") or 1)
-                except ValueError:
-                    delay = 1.0
-                e.read()  # drain the shed response before re-posting
-                time.sleep(delay)
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        return json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    if e.code != 429 or attempt >= self.shed_retries:
+                        raise
+                    attempt += 1
+                    try:
+                        delay = float(e.headers.get("Retry-After") or 1)
+                    except ValueError:
+                        delay = 1.0
+                    e.read()  # drain the shed response before re-posting
+                    time.sleep(delay)
+                    last_err = None
+                    break  # re-post to the SAME endpoint after the shed
+                except OSError as e:
+                    last_err = e
+                    continue  # dead endpoint: try the next one
+            if last_err is not None:
+                raise last_err
 
     def _fetch_segments(self, state: dict) -> list[list]:
         rows: list[list] = []
@@ -87,6 +114,26 @@ class StatementClient:
             except Exception:
                 pass  # best-effort release; server GC covers the rest
         return rows
+
+    def _poll_failover(self, next_uri: str) -> Optional[dict]:
+        """Try the dead nextUri's PATH against the other endpoints — a
+        fleet survivor that adopted the query serves the same
+        /v1/statement/{qid}/... there.  Returns the new poll state (whose
+        nextUri re-pins to the live coordinator) or None."""
+        parts = urlsplit(next_uri)
+        suffix = parts.path + (f"?{parts.query}" if parts.query else "")
+        origin = f"{parts.scheme}://{parts.netloc}"
+        for base in self.endpoints:
+            if base == origin:
+                continue  # that is the host that just refused
+            try:
+                with urllib.request.urlopen(base + suffix, timeout=30) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                continue  # 404 from a non-owner: keep looking
+            except OSError:
+                continue
+        return None
 
     def _apply_prepared_deltas(self, state: dict) -> None:
         for name, text in (state.get("addedPrepare") or {}).items():
@@ -102,6 +149,9 @@ class StatementClient:
                 f"{quote(n)}={quote(s)}" for n, s in self.prepared.items()
             )
         state = self._post_statement(sql, headers)
+        # the fleet router shards by this id (runtime/fleet.py shard_for);
+        # callers attribute the query to a member through it
+        self.last_query_id = state.get("id")
         deadline = time.time() + timeout
         backoff = None  # live only across a re-attach streak
         while True:
@@ -141,6 +191,28 @@ class StatementClient:
                     )
                     exc.error_code = detail.get("errorCode")
                     raise exc
+                if e.code in (429, 503) and self.reattach:
+                    # transient by contract: load shedding, or the fleet
+                    # router bridging an adoption window (a dead member's
+                    # query isn't answerable until a peer replays its
+                    # journal).  Honor Retry-After, bounded by the same
+                    # re-attach clock as connection failures.
+                    if backoff is None:
+                        from ..runtime.failure import Backoff
+
+                        backoff = Backoff(
+                            min_delay=0.1, max_delay=2.0,
+                            max_elapsed=self.reattach_max_elapsed_s,
+                            decorrelated=True,
+                        )
+                    if backoff.failure():
+                        raise
+                    retry_after = e.headers.get("Retry-After")
+                    if retry_after:
+                        time.sleep(min(float(retry_after), 2.0))
+                    else:
+                        backoff.sleep()
+                    continue
                 raise
             except OSError:
                 # coordinator death mid-poll: re-attach through Backoff
@@ -148,12 +220,22 @@ class StatementClient:
                 # Backoff before declaring the peer dead)
                 if not self.reattach:
                     raise
+                # fleet failover first: a surviving endpoint that adopted
+                # the query answers NOW — no backoff spent on the corpse
+                alt = self._poll_failover(next_uri)
+                if alt is not None:
+                    state = alt
+                    backoff = None
+                    continue
                 if backoff is None:
                     from ..runtime.failure import Backoff
 
+                    # decorrelated: a mass re-attach after a coordinator
+                    # death must not arrive at the survivor in waves
                     backoff = Backoff(
                         min_delay=0.1, max_delay=2.0,
                         max_elapsed=self.reattach_max_elapsed_s,
+                        decorrelated=True,
                     )
                 if backoff.failure():
                     raise

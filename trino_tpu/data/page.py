@@ -30,6 +30,17 @@ from .types import BOOLEAN, DATE, Type, days_to_date
 
 __all__ = ["Dictionary", "Column", "Page"]
 
+# content-keyed Dictionary intern table (Dictionary.intern): tuple(values)
+# -> the one shared instance.  Bounded LRU; very large dictionaries bypass
+# it so the key tuples never dominate memory.
+import threading as _threading
+from collections import OrderedDict as _OrderedDict
+
+_INTERN: "_OrderedDict[tuple, Dictionary]" = _OrderedDict()
+_INTERN_LOCK = _threading.Lock()
+_INTERN_MAX_ENTRIES = 4096
+_INTERN_MAX_VALUES = 65536
+
 
 class Dictionary:
     """Host-side string dictionary for a VARCHAR column.
@@ -107,10 +118,38 @@ class Dictionary:
         return rank
 
     @staticmethod
+    def intern(values: np.ndarray) -> "Dictionary":
+        """Content-interned construction: equal value-sets share ONE
+        Dictionary object.  Dictionaries ride jit/compile-service cache
+        keys by IDENTITY (exec/compiler.py _cache_key), so a fresh object
+        per scan/exchange-decode would retrace an identical program on
+        every query; interning makes repeated statements hit those caches.
+        Sharing is safe exactly because content is equal — decoding through
+        either object yields the same strings.  Oversized or unhashable
+        value-sets skip the table (bounded memory, graceful fallback)."""
+        if len(values) > _INTERN_MAX_VALUES:
+            return Dictionary(values)
+        try:
+            key = tuple(values)
+            hash(key)
+        except TypeError:
+            return Dictionary(values)
+        with _INTERN_LOCK:
+            d = _INTERN.get(key)
+            if d is not None:
+                _INTERN.move_to_end(key)
+                return d
+            d = Dictionary(values)
+            _INTERN[key] = d
+            while len(_INTERN) > _INTERN_MAX_ENTRIES:
+                _INTERN.popitem(last=False)
+            return d
+
+    @staticmethod
     def encode(values: Sequence[str]) -> tuple[np.ndarray, "Dictionary"]:
         arr = np.asarray(values, dtype=object)
         uniq, codes = np.unique(arr, return_inverse=True)
-        return codes.astype(np.int32), Dictionary(uniq)
+        return codes.astype(np.int32), Dictionary.intern(uniq)
 
     @staticmethod
     def encode_arrays(values: Sequence) -> tuple[np.ndarray, "Dictionary"]:
@@ -143,7 +182,7 @@ class Dictionary:
             codes[i] = code
         uniq = np.empty(len(interned), dtype=object)
         uniq[:] = interned
-        return codes, Dictionary(uniq)
+        return codes, Dictionary.intern(uniq)
 
     def __repr__(self) -> str:
         return f"Dictionary({len(self.values)} values)"

@@ -24,6 +24,14 @@ Recognized config.properties keys:
     query.resume-policy=RESUME|FAIL|RESTART
                                     what a restarted coordinator does with
                                     journaled in-flight queries
+    fleet.dir=/path                 shared coordinator-fleet directory
+                                    (leases + per-member journals + history)
+    fleet.coordinators=u1,u2        fleet member URLs; a coordinator role
+                                    starts the FleetRouter front door over
+                                    them, a worker role announces to all
+    fleet.lease-ttl-s=10            seconds before an unrenewed lease
+                                    expires and peers adopt its queries
+    fleet.coordinator-id=c1         stable member id (defaults to random)
 
 Connector factories (connector.name=):
     tpch (tpch.scale=), tpcds (tpcds.scale=), memory, blackhole,
@@ -125,6 +133,16 @@ class NodeConfig:
         self.task_concurrency = int(props.get("task.concurrency", "4"))
         self.journal_path = props.get("query.journal-path", "")
         self.resume_policy = props.get("query.resume-policy", "")
+        # coordinator fleet (runtime/fleet.py): shared lease/journal dir,
+        # member list for the router + fleet-aware worker announce
+        self.fleet_dir = props.get("fleet.dir", "")
+        self.fleet_coordinators = [
+            u.strip().rstrip("/")
+            for u in props.get("fleet.coordinators", "").split(",")
+            if u.strip()
+        ]
+        self.fleet_lease_ttl_s = float(props.get("fleet.lease-ttl-s", "10"))
+        self.fleet_coordinator_id = props.get("fleet.coordinator-id", "") or None
 
 
 def load_node_config(etc_dir: str) -> NodeConfig:

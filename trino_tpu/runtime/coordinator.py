@@ -44,6 +44,10 @@ from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
 from .failure import Backoff, FailureDetector
+# imported unconditionally: fleet.py registers the fleet metric families in
+# the GLOBAL registry at import, so /metrics carries their HELP strings even
+# on single-coordinator deployments (scripts/metrics_lint.py contract)
+from .fleet import FLEET_ADOPTIONS, FleetMember
 from .history import QueryHistoryStore
 from .journal import QueryJournal
 from .memory import ClusterMemoryManager
@@ -96,6 +100,9 @@ class Coordinator:
         history_capacity: int = 200,
         history_path: Optional[str] = None,
         journal_path: Optional[str] = None,
+        fleet_dir: Optional[str] = None,
+        fleet_ttl_s: float = 10.0,
+        coordinator_id: Optional[str] = None,
     ):
         from .resourcegroups import ResourceGroupManager
 
@@ -195,6 +202,23 @@ class Coordinator:
         # finished queries older than this are expired (record + spooled
         # segments GC'd) by the heartbeat sweep; 0 disables
         self.query_expiration_seconds = 900.0
+        # coordinator fleet membership (runtime/fleet.py): a shared fleet
+        # dir holds per-member epoch leases, per-member journal files, and
+        # the shared history.  None = classic single-coordinator mode.
+        self.fleet: Optional[FleetMember] = None
+        fdir = fleet_dir or os.environ.get("TRINO_TPU_FLEET_DIR")
+        if fdir:
+            self.fleet = FleetMember(
+                fdir, coordinator_id=coordinator_id, ttl_s=fleet_ttl_s
+            )
+            # fleet defaults: the journal is NAMESPACED per member (the
+            # adopter replays a dead peer's file), the history is SHARED
+            # (every member appends + tails it, replicating cache-admission
+            # hints fleet-wide)
+            if journal_path is None:
+                journal_path = self.fleet.journal_path_for()
+            if history_path is None:
+                history_path = self.fleet.history_path()
         # bounded query history (reference: QueryResource's bounded history
         # behind GET /v1/query): completed QueryInfo+ledger records survive
         # _expire_old_queries — and, with a JSONL path, coordinator restarts
@@ -252,6 +276,11 @@ class Coordinator:
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_port
         self.url = f"http://127.0.0.1:{self.port}"
+        if self.fleet is not None:
+            # the lease carries this member's URL: peers and the router
+            # learn where adopted queries answer from the fleet dir alone
+            self.fleet.url = self.url
+            self.fleet.acquire()
         self._threads = [
             threading.Thread(target=self.httpd.serve_forever, daemon=True),
             threading.Thread(target=self._heartbeat_loop, daemon=True),
@@ -316,6 +345,10 @@ class Coordinator:
         self.httpd.server_close()
         if self.journal is not None:
             self.journal.close()
+        if self.fleet is not None:
+            # graceful exit drops the lease NOW; kill() deliberately does
+            # not — an expired lease is the adoption trigger
+            self.fleet.release()
 
     def kill(self) -> None:
         """Crash analogue (in-process SIGKILL) for recovery tests: stop
@@ -355,57 +388,135 @@ class Coordinator:
         for record in pending:
             if self._hb_stop.is_set():
                 return
-            sm: QueryStateMachine = record["sm"]
-            jq = record.pop("resume_state")
-            policy = str(self.session.get("resume_policy") or "RESUME").upper()
-            # re-apply the journaled session overrides the query ran with,
-            # unless this coordinator was explicitly configured otherwise —
-            # retry_policy and exchange_spool_dir are load-bearing: without
-            # them the resumed query could not re-read its committed output
-            for k, v in (jq.session or {}).items():
-                if k in PROPERTIES and k not in self.session._values:
-                    self.session._values[k] = v
-            self.events.fire(
-                QueryEvent("resumed", sm.query_id, (jq.sql or "")[:500])
+            self._resume_one(record)
+
+    def _resume_one(self, record: dict) -> None:
+        """Take over ONE replayed in-flight query (the PR 7 RESUME path),
+        shared between restart recovery (_resume_replayed) and fleet peer
+        adoption (_fleet_tick): apply the journaled session, honor the
+        resume policy, seed the resume commits so spool-COMMITTED stages
+        are re-read instead of recomputed, and submit through admission."""
+        from .resourcegroups import QueryRejected
+
+        sm: QueryStateMachine = record["sm"]
+        jq = record.pop("resume_state")
+        policy = str(self.session.get("resume_policy") or "RESUME").upper()
+        # re-apply the journaled session overrides the query ran with,
+        # unless this coordinator was explicitly configured otherwise —
+        # retry_policy and exchange_spool_dir are load-bearing: without
+        # them the resumed query could not re-read its committed output
+        for k, v in (jq.session or {}).items():
+            if k in PROPERTIES and k not in self.session._values:
+                self.session._values[k] = v
+        self.events.fire(
+            QueryEvent("resumed", sm.query_id, (jq.sql or "")[:500])
+        )
+        if policy == "FAIL":
+            reason = (
+                "Query was abandoned by a coordinator restart "
+                "(resume_policy=FAIL) [COORDINATOR_RESTART]"
             )
-            if policy == "FAIL":
-                reason = (
-                    "Query was abandoned by a coordinator restart "
-                    "(resume_policy=FAIL) [COORDINATOR_RESTART]"
-                )
-                record["resume_refused"] = True
-                if self.journal is not None:
-                    self.journal.append(
-                        "finish", sm.query_id, state="FAILED",
-                        error=reason, error_code="COORDINATOR_RESTART",
-                    )
-                sm.fail(reason, code="COORDINATOR_RESTART")
-                record["done"].set()
-                self._m_resumed.labels("refused").inc()
-                continue
-            if policy == "RESUME":
-                record["resume_commits"] = jq.commits
-                record["resume_ntasks"] = jq.dispatches
-            record["resume_attempt"] = jq.next_attempt
-            record["journal_replay_ms"] = self.journal_replay_ms
+            record["resume_refused"] = True
             if self.journal is not None:
                 self.journal.append(
-                    "resume", sm.query_id, policy=policy,
-                    attempt=jq.next_attempt,
+                    "finish", sm.query_id, state="FAILED",
+                    error=reason, error_code="COORDINATOR_RESTART",
                 )
+            sm.fail(reason, code="COORDINATOR_RESTART")
+            record["done"].set()
+            self._m_resumed.labels("refused").inc()
+            return
+        if policy == "RESUME":
+            record["resume_commits"] = jq.commits
+            record["resume_ntasks"] = jq.dispatches
+        record["resume_attempt"] = jq.next_attempt
+        record.setdefault("journal_replay_ms", self.journal_replay_ms)
+        if self.journal is not None:
+            self.journal.append(
+                "resume", sm.query_id, policy=policy,
+                attempt=jq.next_attempt,
+            )
 
-            def start(record=record):
-                threading.Thread(
-                    target=self._run_admitted, args=(record,), daemon=True
-                ).start()
+        def start(record=record):
+            threading.Thread(
+                target=self._run_admitted, args=(record,), daemon=True
+            ).start()
 
-            group = self.session.get("resource_group")
-            mem = int(self.session.get("query_max_memory_bytes") or 0)
-            try:
-                self.resource_groups.submit(group, sm.query_id, mem, start)
-            except QueryRejected as e:
-                sm.fail(str(e))
-                record["done"].set()
+        group = self.session.get("resource_group")
+        mem = int(self.session.get("query_max_memory_bytes") or 0)
+        try:
+            self.resource_groups.submit(group, sm.query_id, mem, start)
+        except QueryRejected as e:
+            sm.fail(str(e))
+            record["done"].set()
+
+    # --------------------------------------------------- fleet membership
+    def _fleet_tick(self) -> None:
+        """Per-heartbeat fleet duties: renew the lease (embedding live
+        query ids for the fleet-wide GC union), tail the shared history
+        (replicated cache-admission hints), and adopt expired peers."""
+        if self.fleet is None:
+            return
+        try:
+            with self._lock:
+                live = [
+                    qid for qid, rec in self.queries.items()
+                    if not rec["sm"].done
+                ]
+            self.fleet.renew(live)
+            self.history.refresh()
+            for lease in self.fleet.expired_peers():
+                if self.fleet.try_adopt(lease):
+                    self._adopt_peer(lease)
+        except Exception:
+            traceback.print_exc()
+
+    def _adopt_peer(self, lease: dict) -> None:
+        """Replay a dead peer's journal and take over its in-flight
+        queries through the RESUME path: committed stages are re-read from
+        the spool, never recomputed, and re-attaching clients land on this
+        coordinator's copy of the query with zero visible failures."""
+        peer_id = lease.get("coordinator_id")
+        t0 = time.perf_counter()
+        replayed = QueryJournal.replay(self.fleet.journal_path_for(peer_id))
+        replay_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        adopted = []
+        for qid, jq in replayed.items():
+            if jq.state != "INFLIGHT":
+                continue
+            with self._lock:
+                if qid in self.queries:
+                    continue  # already here (router double-submit etc.)
+                sm = QueryStateMachine(qid)
+                record = self.queries[qid] = {
+                    "sm": sm, "sql": jq.sql, "result": None, "columns": None,
+                    "done": threading.Event(), "spooled": jq.spooled,
+                    "journaled": True, "resumed": True, "resume_state": jq,
+                    "adopted_from": peer_id, "journal_replay_ms": replay_ms,
+                }
+            if self.journal is not None:
+                # re-journal the adopted query into OUR file — with the
+                # peer's dispatch/commit progress — so a later crash of
+                # THIS coordinator hands the chain on intact
+                self.journal.append(
+                    "admit", qid, sql=jq.sql, session=jq.session,
+                    spooled=jq.spooled, adopted_from=peer_id,
+                )
+                for fid, ntasks in jq.dispatches.items():
+                    self.journal.append(
+                        "dispatch", qid, fragment=fid, ntasks=ntasks,
+                        attempt=max(jq.next_attempt - 1, 0),
+                    )
+                for fid, parts in jq.commits.items():
+                    for part, tid in parts.items():
+                        self.journal.append(
+                            "commit", qid, fragment=fid, part=part,
+                            task_id=tid,
+                        )
+            adopted.append(record)
+        for record in adopted:
+            FLEET_ADOPTIONS.inc()
+            self._resume_one(record)
 
     # ------------------------------------------------------------ discovery
     def register_worker(self, url: str) -> None:
@@ -477,6 +588,7 @@ class Coordinator:
             self._enforce_node_memory(mem_snapshots)
             self._enforce_deadlines()
             self._expire_old_queries()
+            self._fleet_tick()
             self._sweep_orphan_tasks(infos)
             self._gc_spool()
 
@@ -489,11 +601,20 @@ class Coordinator:
         memory that no consumer will ever fetch."""
         if self.journal is None:
             return
+        if self.fleet is not None and not self.fleet.is_gc_owner():
+            # destructive sweeps are single-owner in a fleet: exactly one
+            # elected member cancels, so two coordinators can never race a
+            # delete against a peer's adoption
+            return
         with self._lock:
             live = {
                 qid for qid, rec in self.queries.items()
                 if not rec["sm"].done
             }
+        if self.fleet is not None:
+            # a task is an orphan only if NO member claims its query live —
+            # the fleet-wide union from the lease files, not just ours
+            live |= self.fleet.fleet_live_queries()
         for w in workers:
             if not w.alive:
                 continue
@@ -518,11 +639,15 @@ class Coordinator:
         d = self.session.get("exchange_spool_dir") or ""
         if not d or not os.path.isdir(d):
             return
+        if self.fleet is not None and not self.fleet.is_gc_owner():
+            return  # GC is single-owner in a fleet (see _sweep_orphan_tasks)
         with self._lock:
             live = {
                 qid for qid, rec in self.queries.items()
                 if not rec["sm"].done
             }
+        if self.fleet is not None:
+            live |= self.fleet.fleet_live_queries()
         # memoized fragment dirs (memo_*) are owned by the fragment memo —
         # its eviction/invalidation deletes them; the age sweep must not
         live.add(MEMO_PREFIX)
@@ -717,6 +842,7 @@ class Coordinator:
     def submit_query(
         self, sql: str, spooled: bool = False,
         prepared: Optional[dict] = None,
+        query_id: Optional[str] = None,
     ) -> str:
         """Admission-controlled submit (reference: DispatchManager.createQuery
         queueing through resource groups before SqlQueryExecution starts).
@@ -726,10 +852,14 @@ class Coordinator:
         `prepared` is the client's statement registry from its
         X-Trino-Prepared-Statement headers (name -> SQL text): EXECUTE
         resolves against it before falling back to server-side PREPAREs, so
-        stateless clients can replay their registry on every request."""
+        stateless clients can replay their registry on every request.
+
+        `query_id` lets the FLEET ROUTER mint the id (runtime/fleet.py):
+        the id-hash shard must be decided before the coordinator is picked,
+        so the router generates it and forwards via X-Trino-Query-Id."""
         from .resourcegroups import QueryRejected
 
-        qid = f"q_{uuid.uuid4().hex[:12]}"
+        qid = query_id or f"q_{uuid.uuid4().hex[:12]}"
         sm = QueryStateMachine(qid)
         record = {
             "sm": sm, "sql": sql, "result": None, "columns": None,
@@ -738,6 +868,9 @@ class Coordinator:
             "prepared": prepared,
         }
         with self._lock:
+            if qid in self.queries:
+                # router retry of an already-admitted id: idempotent
+                return qid
             self.queries[qid] = record
         if self.journal is not None and isinstance(sql, str):
             # admission is the journal's birth record: a crash after this
@@ -749,6 +882,20 @@ class Coordinator:
                 session=dict(self.session._values),
                 spooled=record["spooled"],
             )
+        if self.fleet is not None:
+            # publish the id into OUR lease before any task can dispatch:
+            # the fleet GC owner treats worker tasks of queries absent from
+            # every lease as orphans, and must never race a peer's
+            # just-admitted query (the heartbeat renew alone leaves a gap)
+            try:
+                with self._lock:
+                    live = [
+                        q for q, rec in self.queries.items()
+                        if not rec["sm"].done
+                    ]
+                self.fleet.renew(live)
+            except Exception:
+                pass
 
         def start():
             threading.Thread(
@@ -899,6 +1046,9 @@ class Coordinator:
             # cached marks hits — which still land here, by design
             "planhash": (record.get("cache") or {}).get("planhash"),
             "cached": bool(record.get("cached")),
+            # plan-cache provenance: the EXECUTE's resolved template feeds
+            # FastPath._recurring_templates fleet-wide (shared history)
+            "template": record.get("template"),
         })
         return qi
 
@@ -972,12 +1122,34 @@ class Coordinator:
                     record["columns"] = (
                         [f"col{i}" for i in range(len(rows[0]))] if rows else ["result"]
                     )
+                    if (
+                        isinstance(stmt, S.Explain) and stmt.analyze
+                        and record.get("adopted_from") and rows
+                    ):
+                        # an adopted EXPLAIN ANALYZE re-ran on THIS member:
+                        # stamp the failover provenance into the rendered
+                        # text (engine.py appends the same footer when the
+                        # adopted query itself is the distributed one)
+                        record["result"] = rows = rows + [(
+                            f"-- fleet: adopted from "
+                            f"{record['adopted_from']} by "
+                            f"{self.fleet.coordinator_id if self.fleet else ''}"
+                            f" (journal replay "
+                            f"{record.get('journal_replay_ms', 0.0):.1f} ms)",
+                        )]
                     if isinstance(stmt, S.ExecuteStmt):
                         # the fast path knows the plan's real output names;
                         # without it EXECUTE results degrade to col0..colN
                         fp = getattr(surface, "_fastpath", None)
                         if fp is not None and fp.last_columns:
                             record["columns"] = list(fp.last_columns)
+                        if fp is not None and fp.last_template:
+                            # resolved template rides into the history
+                            # record: recurrence counts replicate through
+                            # the fleet-shared history store and feed
+                            # plan-cache eviction protection on every
+                            # member (FastPath._recurring_templates)
+                            record["template"] = fp.last_template
                     elif isinstance(stmt, S.Prepare):
                         # protocol echo (reference: Trino's added-prepare
                         # response header): the client mirrors this into its
@@ -1836,6 +2008,19 @@ class Coordinator:
                     record.get("journal_replay_ms") or 0.0
                 ),
             }
+        if record.get("adopted_from"):
+            # fleet provenance: which dead peer this query was adopted
+            # from — rides QueryInfo into history and the EXPLAIN ANALYZE
+            # "-- fleet:" footer (runtime/engine.py)
+            record["query_info"]["fleet"] = {
+                "adopted": True,
+                "adopted_from": record.get("adopted_from"),
+                "coordinator_id": (
+                    self.fleet.coordinator_id if self.fleet else ""
+                ),
+                "stages_resumed": record.get("stages_resumed", 0),
+                "parts_resumed": record.get("parts_resumed", 0),
+            }
         # the phase ledger rides QueryInfo (reference: QueryStats planning/
         # execution/queued durations on GET /v1/query/{id}) and the EXPLAIN
         # ANALYZE footer; final state durations are refreshed at history time
@@ -2397,7 +2582,12 @@ def _make_handler(coord: Coordinator):
                         if prepared is None:
                             prepared = {}
                         prepared[unquote(name)] = unquote(enc)
-                qid = coord.submit_query(sql, spooled=spooled, prepared=prepared)
+                qid = coord.submit_query(
+                    sql, spooled=spooled, prepared=prepared,
+                    # router-minted id (fleet sharding); absent on direct
+                    # client submits
+                    query_id=self.headers.get("X-Trino-Query-Id") or None,
+                )
                 return self._send_json(
                     200,
                     {"id": qid, "nextUri": f"{coord.url}/v1/statement/{qid}/0"},
@@ -2454,6 +2644,7 @@ def _make_handler(coord: Coordinator):
                         f"<td>{_html.escape(rec['sm'].state)}</td>"
                         f"{_age(rec['sm'])}"
                         f"<td>{'hit' if rec.get('cached') else '-'}</td>"
+                        f"<td>{_html.escape(str(rec.get('adopted_from') or '-'))}</td>"
                         f"<td><code>{_html.escape(str(rec.get('sql'))[:120])}</code></td></tr>"
                         for qid, rec in list(coord.queries.items())[-50:]
                     )
@@ -2483,6 +2674,28 @@ def _make_handler(coord: Coordinator):
                     )
                     nworkers = len(coord.workers)
                     nqueries = len(coord.queries)
+                # fleet membership table (lease files — own locking; render
+                # outside coord._lock)
+                fleet_html = ""
+                if coord.fleet is not None:
+                    finfo = coord.fleet.info()
+                    frows = "".join(
+                        f"<tr><td>{_html.escape(str(m.get('coordinator_id')))}</td>"
+                        f"<td>{_html.escape(str(m.get('url')))}</td>"
+                        f"<td>{m.get('epoch')}</td>"
+                        f"<td>{'alive' if m.get('alive') else 'expired'}</td>"
+                        f"<td>{m.get('live_queries')}</td>"
+                        f"<td>{_html.escape(str(m.get('adopted_by') or '-'))}</td></tr>"
+                        for m in finfo["members"]
+                    )
+                    fleet_html = (
+                        f"<h3>fleet (this: {_html.escape(finfo['coordinator_id'])}"
+                        f", epoch {finfo['epoch']}"
+                        f"{', gc owner' if finfo['gc_owner'] else ''})</h3>"
+                        "<table><tr><th>member</th><th>url</th><th>epoch</th>"
+                        "<th>lease</th><th>live queries</th><th>adopted by</th>"
+                        f"</tr>{frows}</table>"
+                    )
                 # history has its own lock — render outside coord._lock
                 hrows = "".join(
                     f"<tr><td>{_html.escape(str(h.get('query_id')))}</td>"
@@ -2505,9 +2718,11 @@ def _make_handler(coord: Coordinator):
                     "<th>mem reserved/cap (B)</th><th>revocable (B)</th>"
                     "<th>blocked</th>"
                     f"</tr>{wrows}</table>"
+                    f"{fleet_html}"
                     f"<h3>queries ({nqueries})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
-                    "<th>in state (s)</th><th>cache</th><th>sql</th></tr>"
+                    "<th>in state (s)</th><th>cache</th><th>origin</th>"
+                    "<th>sql</th></tr>"
                     f"{qrows}</table>"
                     f"<h3>history ({len(coord.history)})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
@@ -2531,17 +2746,17 @@ def _make_handler(coord: Coordinator):
                 self.wfile.write(body)
                 return
             if parts[:2] == ["v1", "info"]:
-                return self._send_json(
-                    200,
-                    {
-                        "workers": [
-                            {"url": w.url, "alive": w.alive}
-                            for w in coord.workers.values()
-                        ],
-                        "queries": len(coord.queries),
-                        "resource_groups": coord.resource_groups.stats(),
-                    },
-                )
+                info = {
+                    "workers": [
+                        {"url": w.url, "alive": w.alive}
+                        for w in coord.workers.values()
+                    ],
+                    "queries": len(coord.queries),
+                    "resource_groups": coord.resource_groups.stats(),
+                }
+                if coord.fleet is not None:
+                    info["fleet"] = coord.fleet.info()
+                return self._send_json(200, info)
             if parts[:2] == ["v1", "query"] and len(parts) == 2:
                 # query listing, live table overlaid on the bounded history
                 # (reference: server QueryResource GET /v1/query with its

@@ -815,6 +815,16 @@ class Engine:
                 f"re-read from spool: {rec.get('stages_resumed', 0)}, "
                 f"parts re-read: {rec.get('parts_resumed', 0)})"
             )
+        # fleet footer: present only on queries a surviving fleet member
+        # adopted from a dead peer's journal (runtime/fleet.py)
+        flt = info.get("fleet") or {}
+        if flt.get("adopted"):
+            text.append(
+                f"-- fleet: adopted from {flt.get('adopted_from')} by "
+                f"{flt.get('coordinator_id')} (stages re-read from spool: "
+                f"{flt.get('stages_resumed', 0)}, parts re-read: "
+                f"{flt.get('parts_resumed', 0)})"
+            )
         # per-signature compile attribution: every distinct XLA program
         # the query built, with its persistent-cache outcome breakdown
         for sig, s in (info.get("compile_signatures") or {}).items():

@@ -41,6 +41,15 @@ class Backoff:
     `success()` resets the streak.  Delays grow min_delay * factor^k up to
     max_delay, each multiplied by a random jitter in [1-jitter, 1+jitter]
     (decorrelates retry storms across consumers hitting one producer).
+
+    `decorrelated=True` switches to decorrelated jitter ("Exponential
+    Backoff And Jitter", AWS Architecture Blog):
+    delay = min(max_delay, uniform(min_delay, 3 * previous_delay)).  The
+    multiplicative-jitter schedule keeps a cohort's k-th retries within
+    ±jitter of the SAME center, so a mass client re-attach after a
+    coordinator death arrives at the survivor in synchronized waves; the
+    decorrelated walk spreads each client's k-th retry over the whole
+    [min_delay, max_delay] range instead.
     """
 
     def __init__(
@@ -50,6 +59,7 @@ class Backoff:
         max_elapsed: float = 30.0,
         factor: float = 2.0,
         jitter: float = 0.25,
+        decorrelated: bool = False,
         rng: Optional[random.Random] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
@@ -61,11 +71,13 @@ class Backoff:
         self.max_elapsed = max_elapsed
         self.factor = factor
         self.jitter = jitter
+        self.decorrelated = decorrelated
         self._rng = rng or random.Random()
         self._clock = clock
         self._sleep = sleep
         self.failure_count = 0
         self.first_failure_at: Optional[float] = None
+        self._prev_delay: Optional[float] = None
 
     def failure(self) -> bool:
         """Record a failed attempt; True == deadline exceeded, give up."""
@@ -78,9 +90,19 @@ class Backoff:
     def success(self) -> None:
         self.failure_count = 0
         self.first_failure_at = None
+        self._prev_delay = None
 
     def delay(self) -> float:
         """Delay before the next attempt, for the current failure count."""
+        if self.decorrelated:
+            prev = self._prev_delay
+            if prev is None:
+                d = self._rng.uniform(self.min_delay, self.min_delay * 3)
+            else:
+                d = self._rng.uniform(self.min_delay, prev * 3)
+            d = min(d, self.max_delay)
+            self._prev_delay = d
+            return d
         k = max(self.failure_count - 1, 0)
         base = min(self.min_delay * (self.factor ** k), self.max_delay)
         if self.jitter:

@@ -123,6 +123,10 @@ class FastPath:
         self._lock = threading.RLock()
         self.last_info: Optional[_Info] = None
         self.last_columns: Optional[list] = None
+        # the template the last EXECUTE resolved to; the coordinator stamps
+        # it into the query-history record so recurrence counts replicate
+        # through the fleet-shared history store (see _recurring_templates)
+        self.last_template: Optional[str] = None
 
     # --------------------------------------------------------------- template
     def _template(self, sql: str):
@@ -283,12 +287,47 @@ class FastPath:
             self._cache[key] = entry
             self._cache.move_to_end(key)
             limit = int(eng.session.get("plan_cache_max_entries") or 64)
+            recurring = (
+                self._recurring_templates()
+                if len(self._cache) > limit
+                else frozenset()
+            )
             while len(self._cache) > limit:
-                self._cache.popitem(last=False)
+                # evict the oldest NON-recurring plan first: recurrence in
+                # the (fleet-shared) history store marks templates a peer's
+                # adopted traffic is about to EXECUTE again
+                victim = next(
+                    (k for k in self._cache if k[0] not in recurring),
+                    next(iter(self._cache)),
+                )
+                del self._cache[victim]
                 PLAN_CACHE_EVENTS.labels("evicted").inc()
         PLAN_CACHE_EVENTS.labels("miss").inc()
         self.last_info = info("miss", used)
         return entry
+
+    def _recurring_templates(self, min_n: int = 2) -> frozenset:
+        """Templates that recurred across the query history — the plan
+        cache's replicated admission hint.  History records carry the
+        resolved EXECUTE template (coordinator._history_record), and in
+        fleet mode the history store is one shared JSONL every member tails
+        (QueryHistoryStore.refresh), so a failover target inherits its
+        peers' recurrence counts and shields the plans the adopted traffic
+        keeps EXECUTE-ing from eviction pressure.  Mirrors
+        ResultCache.admissible: no history wired -> no protection."""
+        coord = getattr(self.engine, "_coord", None)
+        hist = getattr(coord, "history", None) if coord is not None else None
+        if hist is None:
+            return frozenset()
+        counts: dict[str, int] = {}
+        try:
+            for rec in hist.list(limit=1000):
+                t = rec.get("template")
+                if isinstance(t, str) and t:
+                    counts[t] = counts.get(t, 0) + 1
+        except Exception:
+            return frozenset()
+        return frozenset(t for t, n in counts.items() if n >= min_n)
 
     def invalidate_table(self, catalog: str, table: str) -> None:
         """Typed invalidation on DML (Engine.cache_invalidate): drop every
@@ -332,6 +371,7 @@ class FastPath:
         if not bool(eng.session.get("prepared_fastpath_enabled")):
             raise NotFastpath("prepared_fastpath_enabled=false")
         stmt, n_params = self._template(sql)
+        self.last_template = sql
         if len(param_exprs) != n_params:
             raise ValueError(
                 f"prepared statement takes {n_params} parameters,"

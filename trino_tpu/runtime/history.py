@@ -38,6 +38,11 @@ class QueryHistoryStore:
         self.path = path
         self._lock = threading.Lock()
         self._ring: OrderedDict[str, dict] = OrderedDict()
+        # byte offset of the last complete line consumed from `path` —
+        # refresh() tails from here, so a SHARED history file (coordinator
+        # fleet: every member appends, every member tails) replicates
+        # records without re-reading the whole file each heartbeat
+        self._offset = 0
         if path:
             self._load(path)
 
@@ -46,13 +51,30 @@ class QueryHistoryStore:
         """Replay the JSONL tail into the ring (restart survival).  Records
         merge by query_id, so an interrupted run's duplicate lines coalesce
         instead of double-counting."""
+        with self._lock:
+            self._consume_from_offset()
+
+    def _consume_from_offset(self) -> int:
+        """Read complete lines beyond self._offset and merge them (no
+        re-persist: they are already on disk).  Concurrent-writer safe the
+        same way journal replay is: a trailing chunk without its newline is
+        an in-progress foreign append — left for the next call.  Caller
+        holds the lock.  Returns the number of records merged."""
         try:
-            with open(path) as f:
-                lines = f.readlines()
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                blob = f.read()
         except OSError:
-            return
-        for line in lines:
-            line = line.strip()
+            return 0
+        complete, sep, _tail = blob.rpartition(b"\n")
+        if not sep:
+            return 0
+        merged = 0
+        for raw in complete.split(b"\n"):
+            try:
+                line = raw.decode("utf-8").strip()
+            except UnicodeDecodeError:
+                continue
             if not line:
                 continue
             try:
@@ -62,6 +84,19 @@ class QueryHistoryStore:
             qid = rec.get("query_id")
             if qid:
                 self._merge(qid, rec, persist=False)
+                merged += 1
+        self._offset += len(complete) + 1
+        return merged
+
+    def refresh(self) -> int:
+        """Tail records other PROCESSES appended to the shared file since
+        the last load/refresh — how fleet peers replicate each other's
+        cache-admission hints (planhash recurrences, warm signatures).
+        Returns the number of records merged."""
+        if not self.path:
+            return 0
+        with self._lock:
+            return self._consume_from_offset()
 
     def _append_line(self, rec: dict) -> None:
         if not self.path:

@@ -101,56 +101,74 @@ class QueryJournal:
 
     @staticmethod
     def replay(path: str) -> dict[str, JournalQuery]:
-        """Fold the journal into per-query states.  Torn trailing lines
-        (the crash interrupted a write) are skipped, like the history
-        store's loader — everything before them is intact because records
-        are single lines flushed in order."""
+        """Fold the journal into per-query states.
+
+        Snapshot-read: the size is stat'd once and exactly that many bytes
+        are read, so replaying a FOREIGN journal with a live writer (a
+        fleet peer adopting a dead coordinator's file, or mis-detecting a
+        live one) sees a consistent prefix — records appended after the
+        stat are invisible instead of interleaving with the parse.  A
+        trailing chunk without a terminating newline is an in-progress (or
+        crash-torn) write and is dropped; everything before it is intact
+        because records are single lines flushed in order.  Torn lines that
+        DID get their newline (crash mid-fsync) still fail json parsing
+        and are skipped like the history store's loader.
+        """
         states: dict[str, JournalQuery] = {}
         try:
-            f = open(path, "r", encoding="utf-8")
-        except FileNotFoundError:
+            with open(path, "rb") as f:
+                size = os.fstat(f.fileno()).st_size
+                blob = f.read(size)
+        except OSError:
             return states
-        with f:
-            for line in f:
+        # drop the torn/in-progress tail: only complete lines are replayed
+        complete, sep, _tail = blob.rpartition(b"\n")
+        if not sep:
+            return states
+        for raw in complete.split(b"\n"):
+            try:
+                line = raw.decode("utf-8")
+            except UnicodeDecodeError:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn write at crash
+            qid = rec.get("query_id")
+            kind = rec.get("kind")
+            if not qid or not kind:
+                continue
+            st = states.get(qid)
+            if st is None:
+                st = states[qid] = JournalQuery(qid)
+            if kind == "admit":
+                st.sql = rec.get("sql") or ""
+                st.session = rec.get("session") or {}
+                st.created_ts = float(rec.get("ts") or 0.0)
+                st.spooled = bool(rec.get("spooled"))
+            elif kind == "dispatch":
                 try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn write at crash
-                qid = rec.get("query_id")
-                kind = rec.get("kind")
-                if not qid or not kind:
+                    fid = int(rec["fragment"])
+                    st.dispatches[fid] = int(rec["ntasks"])
+                    attempt = int(rec.get("attempt") or 0)
+                except (KeyError, TypeError, ValueError):
                     continue
-                st = states.get(qid)
-                if st is None:
-                    st = states[qid] = JournalQuery(qid)
-                if kind == "admit":
-                    st.sql = rec.get("sql") or ""
-                    st.session = rec.get("session") or {}
-                    st.created_ts = float(rec.get("ts") or 0.0)
-                    st.spooled = bool(rec.get("spooled"))
-                elif kind == "dispatch":
-                    try:
-                        fid = int(rec["fragment"])
-                        st.dispatches[fid] = int(rec["ntasks"])
-                        attempt = int(rec.get("attempt") or 0)
-                    except (KeyError, TypeError, ValueError):
-                        continue
-                    st.next_attempt = max(st.next_attempt, attempt + 1)
-                elif kind == "commit":
-                    try:
-                        fid = int(rec["fragment"])
-                        part = int(rec["part"])
-                        tid = str(rec["task_id"])
-                    except (KeyError, TypeError, ValueError):
-                        continue
-                    st.commits.setdefault(fid, {})[part] = tid
-                elif kind == "resume":
-                    st.next_attempt = max(
-                        st.next_attempt, int(rec.get("attempt") or 0) + 1
-                    )
-                    st.state = "INFLIGHT"  # taken over; not terminal
-                elif kind == "finish":
-                    st.state = rec.get("state") or "FINISHED"
-                    st.error = rec.get("error")
-                    st.error_code = rec.get("error_code")
+                st.next_attempt = max(st.next_attempt, attempt + 1)
+            elif kind == "commit":
+                try:
+                    fid = int(rec["fragment"])
+                    part = int(rec["part"])
+                    tid = str(rec["task_id"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                st.commits.setdefault(fid, {})[part] = tid
+            elif kind == "resume":
+                st.next_attempt = max(
+                    st.next_attempt, int(rec.get("attempt") or 0) + 1
+                )
+                st.state = "INFLIGHT"  # taken over; not terminal
+            elif kind == "finish":
+                st.state = rec.get("state") or "FINISHED"
+                st.error = rec.get("error")
+                st.error_code = rec.get("error_code")
         return states

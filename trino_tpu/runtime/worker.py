@@ -271,8 +271,15 @@ class Worker:
         self.state = "active"
         # set by the launcher/test runner at announce time so drain can
         # POST a goodbye-announce (deregister) instead of silently vanishing
-        # and tripping the coordinator's circuit breaker
-        self.coordinator_url: Optional[str] = None
+        # and tripping the coordinator's circuit breaker.  A fleet-aware
+        # worker holds the WHOLE list (TRINO_TPU_COORDINATORS): it
+        # announces to — and is deregistered from — every member, so any
+        # coordinator can dispatch to it and an adopter already knows it.
+        self.coordinator_urls: list[str] = [
+            u.strip().rstrip("/")
+            for u in (os.environ.get("TRINO_TPU_COORDINATORS") or "").split(",")
+            if u.strip()
+        ]
         # periodic re-announce cadence (0 disables); first announce fires
         # one interval after start — the initial registration is explicit
         self.announce_interval_s = 2.0
@@ -452,40 +459,51 @@ class Worker:
                 return False
             time.sleep(0.05)
 
+    @property
+    def coordinator_url(self) -> Optional[str]:
+        """Single-coordinator compatibility view of coordinator_urls."""
+        return self.coordinator_urls[0] if self.coordinator_urls else None
+
+    @coordinator_url.setter
+    def coordinator_url(self, url: Optional[str]) -> None:
+        self.coordinator_urls = [url.rstrip("/")] if url else []
+
     def _deregister(self) -> None:
         """Goodbye-announce (reference: the discovery server aging out a
-        SHUTTING_DOWN node): tells the coordinator to forget this worker
+        SHUTTING_DOWN node): tells EVERY coordinator to forget this worker
         NOW, so post-drain heartbeat probes don't read as failures and trip
-        the circuit breaker into QUARANTINED."""
-        if not self.coordinator_url:
-            return
-        try:
-            req = urllib.request.Request(
-                f"{self.coordinator_url}/v1/announce",
-                data=json.dumps(
-                    {"url": self.url, "event": "goodbye"}
-                ).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=5) as r:
-                r.read()
-        except Exception:
-            pass  # best-effort; the breaker's DRAINING overlay still holds
+        a circuit breaker into QUARANTINED."""
+        for base in self.coordinator_urls:
+            try:
+                req = urllib.request.Request(
+                    f"{base}/v1/announce",
+                    data=json.dumps(
+                        {"url": self.url, "event": "goodbye"}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    r.read()
+            except Exception:
+                pass  # best-effort; the DRAINING overlay still holds
 
     def _announce(self) -> None:
-        """Keep-alive announce to the coordinator (best-effort): while the
-        coordinator is down this fails silently and retries next interval;
-        the moment a replacement binds the port it re-registers us."""
-        try:
-            req = urllib.request.Request(
-                f"{self.coordinator_url}/v1/announce",
-                data=json.dumps({"url": self.url}).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=2) as r:
-                r.read()
-        except Exception:
-            pass
+        """Keep-alive announce to every fleet coordinator (best-effort):
+        while one is down its announce fails silently and retries next
+        interval; the moment a replacement binds the port it re-registers
+        us — and every OTHER member keeps its registration the whole time,
+        so an adopter dispatches to this worker without waiting."""
+        for base in self.coordinator_urls:
+            try:
+                req = urllib.request.Request(
+                    f"{base}/v1/announce",
+                    data=json.dumps({"url": self.url}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=2) as r:
+                    r.read()
+            except Exception:
+                pass
 
     def _watchdog_loop(self) -> None:
         """No-progress watchdog: fail RUNNING tasks whose progress beats

@@ -23,11 +23,35 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from .runtime.config import load_catalogs, load_node_config
+    from .utils.compilecache import enable_persistent_cache
+
+    # host-keyed on-disk XLA cache: a restarted (or newly launched) node
+    # deserializes warm programs instead of recompiling every fragment
+    enable_persistent_cache()
 
     cfg = load_node_config(args.etc)
     catalogs = load_catalogs(args.etc)
     names = catalogs.names()
     default_catalog = args.default_catalog or (names[0] if names else "memory")
+
+    if cfg.coordinator and cfg.fleet_coordinators and not cfg.fleet_dir:
+        # router role: fleet.coordinators WITHOUT fleet.dir is the front
+        # door over already-running members — shard admission by query-id
+        # hash, fail over on coordinator death, pass 429/503 through
+        from .runtime.fleet import FleetRouter
+
+        router = FleetRouter(cfg.fleet_coordinators, port=cfg.port).start()
+        print(
+            f"fleet router listening on {router.url} -> "
+            f"{', '.join(cfg.fleet_coordinators)}",
+            flush=True,
+        )
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            router.stop()
+        return 0
 
     if cfg.coordinator:
         from .runtime.coordinator import Coordinator
@@ -38,6 +62,11 @@ def main(argv=None) -> int:
             port=cfg.port,
             cluster_memory_limit_bytes=cfg.cluster_memory_limit_bytes,
             journal_path=cfg.journal_path or None,
+            # fleet membership: journal/history move into the shared dir
+            # and the lease machinery arms (runtime/fleet.py)
+            fleet_dir=cfg.fleet_dir or None,
+            fleet_ttl_s=cfg.fleet_lease_ttl_s,
+            coordinator_id=cfg.fleet_coordinator_id,
         )
         # session defaults are applied BEFORE start(): journal recovery
         # (the resume thread) reads resume_policy / spool dir at takeover
@@ -66,14 +95,27 @@ def main(argv=None) -> int:
         node_memory_bytes=cfg.node_memory_bytes,
     ).start()
     print(f"worker listening on {worker.url}", flush=True)
-    if cfg.discovery_uri:
-        worker.coordinator_url = cfg.discovery_uri  # drain deregisters here
-        req = urllib.request.Request(
-            f"{cfg.discovery_uri}/v1/announce",
-            data=json.dumps({"url": worker.url}).encode(),
-        )
-        urllib.request.urlopen(req, timeout=10).read()
-        print(f"announced to {cfg.discovery_uri}", flush=True)
+    # fleet-aware discovery: announce to EVERY coordinator in
+    # fleet.coordinators (or TRINO_TPU_COORDINATORS, already parsed by the
+    # Worker itself), falling back to the single discovery.uri — any fleet
+    # member can then dispatch to this worker, and an adopter needs no
+    # re-announce round-trip before resuming a dead peer's query
+    coords = cfg.fleet_coordinators or worker.coordinator_urls
+    if not coords and cfg.discovery_uri:
+        coords = [cfg.discovery_uri]
+    if coords:
+        worker.coordinator_urls = [u.rstrip("/") for u in coords]
+        for base in worker.coordinator_urls:
+            try:
+                req = urllib.request.Request(
+                    f"{base}/v1/announce",
+                    data=json.dumps({"url": worker.url}).encode(),
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+                print(f"announced to {base}", flush=True)
+            except OSError as e:
+                # a dead member re-learns us from the periodic announce
+                print(f"announce to {base} failed ({e}); will retry", flush=True)
 
     # SIGTERM == graceful drain (reference: GracefulShutdownHandler bound
     # to the shutdown hook): finish running tasks, commit output, serve

@@ -143,6 +143,43 @@ class ChaosRunner:
             raise err[0]
         return rows
 
+    def run_protocol_query_with_action(
+        self, sql: str, action, delay_s: float = 0.1,
+        max_elapsed_s: float = 60.0,
+    ) -> list[tuple]:
+        """Fleet lifecycle chaos: run `sql` through the HTTP protocol (the
+        router front door when the runner has one) with `action()` fired
+        mid-flight — e.g. hard-kill one coordinator of a fleet
+        (runner.kill_coordinator(index)).  The client must ride through
+        with ZERO visible failures: endpoint failover + re-attach cover the
+        window until a peer adopts the query."""
+        import threading
+        import time as _time
+
+        from ..client import StatementClient
+
+        err: list[BaseException] = []
+
+        def _fire():
+            _time.sleep(delay_s)
+            try:
+                action()
+            except BaseException as e:  # surfaced below, not swallowed
+                err.append(e)
+
+        t = threading.Thread(target=_fire, daemon=True)
+        t.start()
+        try:
+            _, rows = StatementClient(
+                self.runner.client_url,
+                reattach_max_elapsed_s=max_elapsed_s,
+            ).execute(sql)
+        finally:
+            t.join()
+        if err:
+            raise err[0]
+        return [tuple(r) for r in rows]
+
     # ------------------------------------------------------------ observability
 
     def fired(self) -> list[tuple[str, str]]:
@@ -166,15 +203,21 @@ def make_chaos_cluster(
     heartbeat_interval: float = 1.0,
     seed: int = 0,
     modes: Sequence[str] = RECOVERABLE_MODES,
+    num_coordinators: int = 1,
+    fleet_ttl_s: float = 10.0,
 ) -> tuple[DistributedQueryRunner, ChaosRunner]:
     """Start a retry_policy=TASK cluster plus its ChaosRunner.  The caller
-    owns shutdown (runner.stop())."""
+    owns shutdown (runner.stop()).  num_coordinators>1 stands up a
+    coordinator fleet behind a FleetRouter for failover chaos."""
     runner = DistributedQueryRunner(
         num_workers=num_workers,
         default_catalog=default_catalog,
         heartbeat_interval=heartbeat_interval,
+        num_coordinators=num_coordinators,
+        fleet_ttl_s=fleet_ttl_s,
     )
     runner.register_catalog(default_catalog, catalog_factory())
     runner.start()
-    runner.coordinator.session.set("retry_policy", "TASK")
+    for coord in runner.coordinators:
+        coord.session.set("retry_policy", "TASK")
     return runner, ChaosRunner(runner, seed=seed, modes=modes)

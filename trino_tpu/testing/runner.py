@@ -11,6 +11,8 @@ scheduling, loopback sockets.
 from __future__ import annotations
 
 import json
+import shutil
+import tempfile
 import urllib.request
 from typing import Optional
 
@@ -31,6 +33,9 @@ class DistributedQueryRunner:
         cluster_memory_limit_bytes: int = 0,
         node_memory_bytes: Optional[int] = None,
         journal_path: Optional[str] = None,
+        num_coordinators: int = 1,
+        fleet_dir: Optional[str] = None,
+        fleet_ttl_s: float = 10.0,
     ):
         self.catalogs = CatalogManager()
         self.default_catalog = default_catalog
@@ -40,20 +45,69 @@ class DistributedQueryRunner:
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.node_memory_bytes = node_memory_bytes
         self.journal_path = journal_path
-        self.coordinator: Optional[Coordinator] = None
+        # coordinator fleet (runtime/fleet.py): N>1 members share a lease
+        # dir (auto-created when not given) behind a FleetRouter front door
+        self.num_coordinators = num_coordinators
+        self.fleet_dir = fleet_dir
+        self.fleet_ttl_s = fleet_ttl_s
+        self._fleet_tmp: Optional[str] = None
+        self.router = None
+        self.coordinators: list[Coordinator] = []
         self.workers: list[Worker] = []
+
+    # `runner.coordinator` predates the fleet: keep it meaning "the first
+    # coordinator" so single-coordinator tests read unchanged, and let
+    # restart_coordinator() assign the replacement through the setter
+    @property
+    def coordinator(self) -> Optional[Coordinator]:
+        return self.coordinators[0] if self.coordinators else None
+
+    @coordinator.setter
+    def coordinator(self, coord: Optional[Coordinator]) -> None:
+        if coord is None:
+            self.coordinators = []
+        elif self.coordinators:
+            self.coordinators[0] = coord
+        else:
+            self.coordinators.append(coord)
+
+    @property
+    def client_url(self) -> str:
+        """What a client should connect to: the router in fleet mode."""
+        if self.router is not None:
+            return self.router.url
+        return self.coordinator.url
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
 
-    def start(self) -> "DistributedQueryRunner":
-        self.coordinator = Coordinator(
+    def _make_coordinator(self, index: int, port: int = 0) -> Coordinator:
+        fdir = self.fleet_dir
+        return Coordinator(
             self.catalogs,
             self.default_catalog,
+            port=port,
             heartbeat_interval=self.heartbeat_interval,
             cluster_memory_limit_bytes=self.cluster_memory_limit_bytes,
-            journal_path=self.journal_path,
-        ).start()
+            # fleet members journal into their leased per-member namespace
+            journal_path=None if fdir else self.journal_path,
+            fleet_dir=fdir,
+            fleet_ttl_s=self.fleet_ttl_s,
+            coordinator_id=f"c{index}" if fdir else None,
+        )
+
+    def start(self) -> "DistributedQueryRunner":
+        if self.num_coordinators > 1 and self.fleet_dir is None:
+            self._fleet_tmp = tempfile.mkdtemp(prefix="trino_tpu_fleet_")
+            self.fleet_dir = self._fleet_tmp
+        for i in range(self.num_coordinators):
+            self.coordinators.append(self._make_coordinator(i).start())
+        if self.num_coordinators > 1:
+            from ..runtime.fleet import FleetRouter
+
+            self.router = FleetRouter(
+                [c.url for c in self.coordinators]
+            ).start()
         for _ in range(self.num_workers):
             w = Worker(
                 self.catalogs,
@@ -62,22 +116,30 @@ class DistributedQueryRunner:
                 node_memory_bytes=self.node_memory_bytes,
             ).start()
             self.workers.append(w)
-            # the worker knows its coordinator so a completed drain can
-            # deregister itself (goodbye announce)
-            w.coordinator_url = self.coordinator.url
+            # the worker knows every coordinator so a completed drain can
+            # deregister itself and any fleet member can dispatch to it
+            w.coordinator_urls = [c.url for c in self.coordinators]
             # announce over the wire like a real worker would
-            req = urllib.request.Request(
-                f"{self.coordinator.url}/v1/announce",
-                data=json.dumps({"url": w.url}).encode(),
-            )
-            urllib.request.urlopen(req, timeout=10).read()
+            for c in self.coordinators:
+                req = urllib.request.Request(
+                    f"{c.url}/v1/announce",
+                    data=json.dumps({"url": w.url}).encode(),
+                )
+                urllib.request.urlopen(req, timeout=10).read()
         return self
 
     def stop(self) -> None:
         for w in self.workers:
             w.stop()
-        if self.coordinator is not None:
-            self.coordinator.stop()
+        if self.router is not None:
+            self.router.stop()
+        for c in self.coordinators:
+            try:
+                c.stop()
+            except Exception:
+                pass  # a killed member has nothing left to stop
+        if self._fleet_tmp is not None:
+            shutil.rmtree(self._fleet_tmp, ignore_errors=True)
 
     def drain_worker(self, index: int) -> None:
         """Trigger a graceful drain over the wire (PUT /v1/info/state
@@ -95,20 +157,22 @@ class DistributedQueryRunner:
         tasks are abandoned — recovery must come from retry/spool."""
         self.workers[index].kill()
 
-    def kill_coordinator(self) -> int:
-        """Crash the coordinator (the SIGKILL analogue): the HTTP server
+    def kill_coordinator(self, index: int = 0) -> int:
+        """Crash a coordinator (the SIGKILL analogue): the HTTP server
         stops and every scheduling thread abandons its work mid-flight —
-        no task cleanup, no spool remove_query, no journal finish.  Workers
-        keep running and serving their buffers.  Returns the port so a
-        restart can rebind the same client-visible URL."""
-        port = self.coordinator.port
-        self.coordinator.kill()
+        no task cleanup, no spool remove_query, no journal finish, no lease
+        release (fleet peers see the lease EXPIRE and adopt).  Workers keep
+        running and serving their buffers.  Returns the port so a restart
+        can rebind the same client-visible URL."""
+        port = self.coordinators[index].port
+        self.coordinators[index].kill()
         return port
 
     def restart_coordinator(
         self,
         port: Optional[int] = None,
         session: Optional[dict] = None,
+        index: int = 0,
     ) -> Coordinator:
         """Boot a replacement coordinator on the same port (clients keep
         polling an unchanged nextUri) against the same catalogs and
@@ -116,39 +180,34 @@ class DistributedQueryRunner:
         journal-resume thread sees them (resume_policy, spool dir).  Live
         workers are re-pointed and re-announced immediately — their own
         periodic announce would also find it within one interval."""
-        port = port if port is not None else self.coordinator.port
-        self.coordinator = Coordinator(
-            self.catalogs,
-            self.default_catalog,
-            port=port,
-            heartbeat_interval=self.heartbeat_interval,
-            cluster_memory_limit_bytes=self.cluster_memory_limit_bytes,
-            journal_path=self.journal_path,
-        )
+        port = port if port is not None else self.coordinators[index].port
+        coord = self._make_coordinator(index, port=port)
+        self.coordinators[index] = coord
         for name, value in (session or {}).items():
-            self.coordinator.session.set(name, str(value))
-        self.coordinator.start()
+            coord.session.set(name, str(value))
+        coord.start()
         for w in self.workers:
-            w.coordinator_url = self.coordinator.url
+            w.coordinator_urls = [c.url for c in self.coordinators]
             try:
                 req = urllib.request.Request(
-                    f"{self.coordinator.url}/v1/announce",
+                    f"{coord.url}/v1/announce",
                     data=json.dumps({"url": w.url}).encode(),
                 )
                 urllib.request.urlopen(req, timeout=10).read()
             except Exception:
                 pass  # a killed worker can't be re-announced
-        return self.coordinator
+        return coord
 
     def query(self, sql: str) -> list[tuple]:
         """Direct (synchronous) execution through the scheduler."""
         return [tuple(r) for r in self.coordinator.execute_query(sql)]
 
     def query_via_protocol(self, sql: str) -> list[tuple]:
-        """Through the HTTP client protocol (POST /v1/statement + nextUri)."""
+        """Through the HTTP client protocol (POST /v1/statement + nextUri),
+        via the fleet router when one is running."""
         from ..client import StatementClient
 
-        _, rows = StatementClient(self.coordinator.url).execute(sql)
+        _, rows = StatementClient(self.client_url).execute(sql)
         return [tuple(r) for r in rows]
 
     def inject_task_failure(
