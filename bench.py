@@ -60,7 +60,14 @@ plan cache vs the same workload as ad-hoc SQL text — every literal change
 replanned and retraced — reporting both QPS/p50/p99 and the speedup),
 BENCH_CONC_BATCH_MS (default 0: execute_batch_window_ms applied to the
 prepared pass — concurrent same-plan EXECUTEs merge into one vmapped
-device dispatch).
+device dispatch),
+BENCH_MULTI_SCALE (default 1; 0 disables the split-driven scale sweep:
+the same queries at BENCH_MS_SFS scales through a split-scheduling
+cluster, reporting per-query split counts, split retries, and the jit-
+signature count per scale — `multi_scale.signature_invariant` is the
+scale-invariance witness; perf_gate.py ignores the block by design),
+BENCH_MS_SFS (default 0.01,0.02), BENCH_MS_QUERIES (default q01,q06),
+BENCH_MS_TARGET_ROWS (default 8192).
 """
 
 import json
@@ -571,6 +578,85 @@ def _bench_prepared(deadline) -> dict:
         runner.stop()
 
 
+def _bench_multi_scale(deadline) -> dict:
+    """Split-driven scale sweep (ISSUE 14): the same queries at several
+    BENCH_MS_SFS data scales through a split-scheduling cluster.  Reports,
+    per scale and query: split count, split retries, wall time, and the
+    number of distinct jit signatures the run touched — the tentpole claim
+    is that the split COUNT moves with data while the signature count does
+    NOT (``signature_invariant`` per query).  Informational only:
+    scripts/perf_gate.py ignores this block by design.
+
+    Knobs: BENCH_MS_SFS (default "0.01,0.02"), BENCH_MS_QUERIES (default
+    "q01,q06"), BENCH_MS_TARGET_ROWS (default 8192).
+    """
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing import DistributedQueryRunner
+    from trino_tpu.utils.profiler import PROFILER
+
+    sfs = [float(s) for s in
+           os.environ.get("BENCH_MS_SFS", "0.01,0.02").split(",") if s]
+    qnames = [q for q in
+              os.environ.get("BENCH_MS_QUERIES", "q01,q06").split(",") if q]
+    target = int(os.environ.get("BENCH_MS_TARGET_ROWS", "8192"))
+
+    def uses(e):
+        return (e.get("executes", 0) + e.get("compiles", 0)
+                + e.get("fallback_executes", 0))
+
+    out: dict = {"target_rows": target, "scales": {}}
+    sig_counts: dict[str, list[int]] = {}
+    for sf in sfs:
+        if deadline.remaining() < 60:
+            out["scales"][str(sf)] = {"skipped": "deadline"}
+            continue
+        runner = DistributedQueryRunner(
+            num_workers=2, default_catalog="tpch", heartbeat_interval=0.5
+        )
+        runner.register_catalog("tpch", TpchConnector(sf))
+        runner.start()
+        s = runner.coordinator.session
+        s.set("retry_policy", "TASK")
+        s.set("split_driven_scans", "true")
+        s.set("split_target_rows", str(target))
+        per_scale: dict = {}
+        try:
+            for q in qnames:
+                if deadline.remaining() < 30:
+                    per_scale[q] = {"skipped": "deadline"}
+                    continue
+                before = PROFILER.snapshot()
+                t0 = time.perf_counter()
+                runner.query(QUERIES[q])
+                wall = time.perf_counter() - t0
+                after = PROFILER.snapshot()
+                nsigs = sum(
+                    1 for sig, e in after.items()
+                    if uses(e) > uses(before.get(sig, {}))
+                )
+                info = None
+                for rec in runner.coordinator.queries.values():
+                    qi = rec.get("query_info") or {}
+                    if qi.get("splits"):
+                        info = qi["splits"]
+                per_scale[q] = {
+                    "wall_s": round(wall, 3),
+                    "splits": (info or {}).get("splits"),
+                    "split_retries": (info or {}).get("retries", 0),
+                    "jit_signatures": nsigs,
+                }
+                sig_counts.setdefault(q, []).append(nsigs)
+        except Exception as e:
+            per_scale["error"] = str(e)[:200]
+        finally:
+            runner.stop()
+        out["scales"][str(sf)] = per_scale
+    out["signature_invariant"] = {
+        q: len(set(c)) == 1 for q, c in sig_counts.items() if len(c) > 1
+    }
+    return out
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1"))
     runs = int(os.environ.get("BENCH_RUNS", "5"))
@@ -817,6 +903,14 @@ def main() -> None:
             except Exception as e:
                 result["concurrency"]["fleet"] = {"error": str(e)[:200]}
             emit()
+
+    # ---- split-driven multi-scale sweep (ISSUE 14), budget-gated ---------
+    if os.environ.get("BENCH_MULTI_SCALE", "1") != "0" and deadline.remaining() > 120:
+        try:
+            result["multi_scale"] = _bench_multi_scale(deadline)
+        except Exception as e:
+            result["multi_scale"] = {"error": str(e)[:200]}
+        emit()
 
     # ---- serving fast path: PREPARE/EXECUTE vs ad-hoc text (ISSUE 10) ----
     if os.environ.get("BENCH_CONC_PREPARED", "0") == "1" and deadline.remaining() > 60:

@@ -23,6 +23,13 @@
 #   cache   hot cached query under DML + worker kill + coordinator
 #           restart — typed invalidation and the cold-restart contract
 #           mean no step may ever return a stale row
+# Split-driven scan chaos (tests/test_splits.py):
+#   splits  worker kill mid-scan on a split-scheduling cluster — only the
+#           LOST morsels re-read (split retries < total splits), committed
+#           morsels served from the spool, zero client-visible failures;
+#           plus SPLIT_LOST injection, the jit-signature scale-invariance
+#           witness for tpch q01/q06 at two data scales, and the at-scale
+#           kill drill on tpch lineitem (CHAOS_SF, default sf1)
 # Coordinator-fleet chaos (tests/test_fleet.py):
 #   fleet   kill one coordinator of a two-member fleet mid multi-stage
 #           query — a peer adopts it off the dead member's journal
@@ -66,6 +73,11 @@ case "${1:-}" in
   coordinator)
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_recovery.py -q \
+        -p no:cacheprovider "$@"
+    ;;
+  splits)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_splits.py -q \
         -p no:cacheprovider "$@"
     ;;
   fleet)

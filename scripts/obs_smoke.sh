@@ -167,6 +167,31 @@ try:
     after = _kernels._DISPATCH.value("group_by", "pallas")
     assert after > before, "kernel dispatch counter did not move"
     print(f"kernel dispatch: {klines[0]} (counter {before:.0f} -> {after:.0f})")
+
+    # split-driven scan plane (runtime/splits.py): with split_driven_scans
+    # on, a scan must morselize — visible as the `-- splits:` EXPLAIN
+    # ANALYZE footer and a nonzero trino_tpu_splits_total on /metrics
+    import tempfile as _tf
+    coord.session.set("retry_policy", "TASK")
+    coord.session.set("exchange_spool_dir",
+                      _tf.mkdtemp(prefix="obs_split_spool_"))
+    coord.session.set("split_driven_scans", "true")
+    coord.session.set("split_target_rows", "8192")
+    srows = runner.query("explain analyze " + SQL)
+    stext = "\n".join(r[0] for r in srows)
+    slines = [ln for ln in stext.splitlines() if ln.startswith("-- splits:")]
+    assert slines, f"expected a splits footer:\n{stext[-800:]}"
+    print(f"splits: {slines[0]}")
+    smtext2 = get(base + "/metrics")
+    done = [
+        ln for ln in smtext2.splitlines()
+        if ln.startswith('trino_tpu_splits_total{state="completed"}')
+    ]
+    assert done and float(done[0].split()[-1]) > 0, (
+        f"expected a nonzero completed-splits counter: {done}"
+    )
+    print(f"splits completed counter: {done[0].split()[-1]}")
+    coord.session.set("split_driven_scans", "false")
 finally:
     runner.stop()
 

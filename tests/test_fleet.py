@@ -484,3 +484,103 @@ def test_kill_one_of_two_adoption_zero_recompute(tmp_path):
     finally:
         conn.gate.set()
         runner.stop()
+
+
+# ------------------------------------------- router failover-response audit
+
+
+def _static_backend(code, body=b"{}", headers=None):
+    """One fake coordinator that answers every request with a fixed
+    verdict — the router's failover contract is tested against it."""
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _go(self):
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = do_POST = do_DELETE = _go
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def test_router_midpoll_502_fails_over_and_counts_retry():
+    """A member mid-teardown answers a poll with 502: the router must try
+    the peer (the query may have been adopted), count the hop in
+    trino_tpu_fleet_router_retries_total, and the client sees only the
+    peer's 200."""
+    from trino_tpu.runtime.fleet import FLEET_ROUTER_RETRIES
+
+    bad_srv, bad = _static_backend(502, b'{"error": "teardown"}')
+    ok_srv, ok = _static_backend(200, b'{"ok": true}')
+    # a query id whose shard OWNER is the 502 member, so the poll hits the
+    # bad coordinator first and must fail over
+    qid = next(q for q in (f"q_{i}" for i in range(100))
+               if shard_for(q, 2) == 0)
+    router = FleetRouter([bad, ok]).start()
+    try:
+        before = FLEET_ROUTER_RETRIES.value()
+        with urllib.request.urlopen(
+            f"{router.url}/v1/statement/{qid}/0", timeout=10
+        ) as r:
+            assert r.status == 200 and b"ok" in r.read()
+        assert FLEET_ROUTER_RETRIES.value() == before + 1
+    finally:
+        router.stop()
+        bad_srv.shutdown()
+        ok_srv.shutdown()
+
+
+def test_router_unanimous_502_passes_through_with_retry_after():
+    """Every member says 502: transient, pass it through — and the reply
+    MUST carry Retry-After even though no backend set one (the router's
+    failover-response contract: every 429/502/503 tells the client when
+    to come back)."""
+    b0_srv, b0 = _static_backend(502, b'{"error": "x"}')
+    b1_srv, b1 = _static_backend(502, b'{"error": "x"}')
+    router = FleetRouter([b0, b1]).start()
+    try:
+        req = urllib.request.Request(f"{router.url}/v1/statement/q_ab/0")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 502
+        assert ei.value.headers.get("Retry-After") == "1"
+    finally:
+        router.stop()
+        b0_srv.shutdown()
+        b1_srv.shutdown()
+
+
+@pytest.mark.parametrize("code", [429, 503])
+def test_router_injects_retry_after_on_bare_shed(code):
+    """A backend that sheds (429) or is mid-adoption (503) WITHOUT a
+    Retry-After hint: the router adds its 1s default instead of silently
+    dropping the backpressure signal; a backend-set value passes through
+    untouched."""
+    srv, url = _static_backend(code, b'{"error": "busy"}')
+    srv2, url2 = _static_backend(code, b'{"error": "busy"}',
+                                 headers={"Retry-After": "7"})
+    for backend_srv, backend, want in ((srv, url, "1"), (srv2, url2, "7")):
+        router = FleetRouter([backend]).start()
+        try:
+            req = urllib.request.Request(
+                f"{router.url}/v1/statement/q_cd/0"
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == code
+            assert ei.value.headers.get("Retry-After") == want
+        finally:
+            router.stop()
+    srv.shutdown()
+    srv2.shutdown()

@@ -112,6 +112,11 @@ class LocalExecutor:
         # ALL parts share one compiled program — the out-of-core executor
         # iterates parts through a single jit cache entry this way
         self.pad_splits = False
+        # split-driven scans (runtime/splits.py): fixed scan-page capacity
+        # every morsel pads to, regardless of how many rows its row range
+        # actually holds — scan shapes (and therefore jit signatures) stop
+        # depending on data scale; only the split COUNT scales.  None = off.
+        self.split_pad_rows: Optional[int] = None
         # dynamic filters: scan_node_id -> (ScanFilter, ...) applied host-side
         # before upload (exec/dynfilter.py); rows outside the build-side key
         # domain never cost HBM bandwidth or kernel lanes
@@ -252,6 +257,11 @@ class LocalExecutor:
                 total = conn.estimated_row_count(table)
                 if total:
                     pad_to = max(1, -(-int(total) // num_parts))
+            if self.split_pad_rows:
+                # morsel mode: a fixed capacity wins over both the filtered
+                # pow2 and the ceil(total/num_parts) pads (a filtered morsel
+                # can only shrink below it, never grow past it)
+                pad_to = max(pad_to, int(self.split_pad_rows))
             for c in missing:
                 arr = data[c]
                 n_live = len(arr)

@@ -62,6 +62,11 @@ class SpmdExecutor(LocalExecutor):
         full = self.table_page(node.catalog, node.table, node.column_names, node.output_types)
         n = full.capacity
         cap_local = max(1, -(-n // D))
+        if self.split_pad_rows:
+            # pow2-bucket the per-device shard like the split-driven
+            # distributed path: two data scales share shard shape classes
+            pad = int(self.split_pad_rows)
+            cap_local = -(-cap_local // pad) * pad
         total = D * cap_local
         cols = []
         for col in full.columns:

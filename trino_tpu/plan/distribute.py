@@ -98,6 +98,19 @@ class _Distributor:
             return _BROADCAST_LIMIT
         return self.session.get("broadcast_join_row_limit")
 
+    def _broadcast_fanout(self, probe: PlanNode) -> float:
+        """How many consumers fetch a replicated build.  Classically one
+        per device; under split_driven_scans (runtime/splits.py) a
+        morselized probe runs ceil(rows / split_target_rows) tasks and
+        EACH fetches the whole build — broadcast cost scales with the
+        split count, never less than the device count."""
+        if self.session is None or not self.session.get("split_driven_scans"):
+            return float(self.num_devices)
+        target = int(self.session.get("split_target_rows") or 65536)
+        pad = 1 << max(0, (max(1, target) - 1).bit_length())
+        nsplits = -(-self.est_rows(probe) // pad)
+        return float(max(self.num_devices, nsplits))
+
     # ------------------------------------------------------------ size model
     def est_rows(self, node: PlanNode) -> float:
         """Cardinality from the stats calculator (plan/stats.py): connector
@@ -424,7 +437,10 @@ class _Distributor:
             l_bytes = self.est_rows(node.left) * _bytes_per_row(
                 node.left.output_types
             )
-            cheaper_to_broadcast = r_bytes * self.num_devices <= l_bytes + r_bytes
+            cheaper_to_broadcast = (
+                r_bytes * self._broadcast_fanout(node.left)
+                <= l_bytes + r_bytes
+            )
         broadcast = (
             (mode == "BROADCAST")
             or cheaper_to_broadcast

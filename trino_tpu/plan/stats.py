@@ -29,7 +29,7 @@ from .nodes import (
     Project, RemoteSource, Sort, TableScan, TopN, Values, Window,
 )
 
-__all__ = ["PlanStats", "estimate"]
+__all__ = ["PlanStats", "estimate", "scan_rows"]
 
 _DEFAULT_FILTER_SEL = 0.3
 _DEFAULT_ROWS = 1_000_000.0
@@ -40,6 +40,27 @@ class PlanStats:
     rows: float
     # output column index -> ColumnStats (only where derivable)
     columns: dict
+
+
+def scan_rows(node: TableScan, catalogs: CatalogManager):
+    """Physical row count of a scanned table, or ``None`` when the connector
+    cannot say.  Split enumeration wants the *actual* count — falling back to
+    the statistical default would mint phantom splits for a tiny no-stats
+    table — so unlike :func:`estimate` this never substitutes a guess."""
+    conn = catalogs.get(node.catalog)
+    try:
+        n = conn.estimated_row_count(node.table)
+        if n is not None:
+            return float(n)
+    except Exception:
+        pass
+    try:
+        ts = conn.table_stats(node.table)
+        if ts is not None:
+            return float(ts.row_count)
+    except Exception:
+        pass
+    return None
 
 
 def estimate(node: PlanNode, catalogs: CatalogManager) -> PlanStats:

@@ -56,6 +56,9 @@ from .resultcache import (
     plan_version_vector,
 )
 from .session import PROPERTIES, SessionProperties
+# imported unconditionally for the same reason as fleet: splits.py registers
+# the split metric families in the GLOBAL registry at import
+from .splits import SplitScheduler, current_backlog, scan_split_plan
 from .spool import SPOOL_URL, SpooledExchange
 from .statemachine import QueryStateMachine
 from .wire import wire_to_page
@@ -124,6 +127,12 @@ class Coordinator:
         # holder (forced spill), then kills the largest total reservation
         self.cluster_memory_manager = ClusterMemoryManager()
         self.oom_kills = 0  # queries killed with CLUSTER_OUT_OF_MEMORY
+        # split-plane memory integration: workers whose lease was revoked
+        # are parked out of split assignment until the revocation had time
+        # to land (url -> park time; runtime/splits.py consults via
+        # _split_parked)
+        self._split_park: dict[str, float] = {}
+        self.split_park_s = 5.0
         self._lock = threading.Lock()
         self.heartbeat_interval = heartbeat_interval
         # coordinator control-plane metrics, exposed at GET /metrics in
@@ -658,6 +667,18 @@ class Coordinator:
         except Exception:
             traceback.print_exc()
 
+    def _split_parked(self, url: str) -> bool:
+        """Is this worker parked out of split assignment?  A park expires
+        split_park_s after the revocation that set it — by then the forced
+        spill either landed (pressure gone) or the next sweep re-parks."""
+        ts = self._split_park.get(url)
+        if ts is None:
+            return False
+        if time.monotonic() - ts > self.split_park_s:
+            self._split_park.pop(url, None)
+            return False
+        return True
+
     def _enforce_cluster_memory(self, by_query: dict[str, int]) -> None:
         """Kill the biggest reservation when the cluster exceeds its memory
         limit (reference: ClusterMemoryManager + TotalReservation
@@ -729,6 +750,11 @@ class Coordinator:
         for act in actions:
             if act["action"] == "revoke":
                 self._m_revocations_requested.inc()
+                # split-driven scans: a revoked lease PARKS the node in the
+                # split scheduler — its queued splits wait (or drain to
+                # peers) while the revocation lands, instead of the old
+                # whole-task 4x re-slice (runtime/splits.py)
+                self._split_park[act["node"]] = time.monotonic()
                 try:
                     req = urllib.request.Request(
                         f"{act['node']}/v1/memory/revoke",
@@ -1448,6 +1474,21 @@ class Coordinator:
                 consumer_of[child] = f.id
 
         phased = self.session.get("retry_policy") == "TASK"
+        # split-driven scans (runtime/splits.py): a row-range scan fragment's
+        # fan-out becomes its runtime split count — one task per
+        # fixed-capacity morsel — instead of the worker count, and the
+        # payload pins every morsel's scan-page capacity.  Phased-only: the
+        # per-task retry/steal machinery IS the per-split machinery
+        split_plans: dict[int, tuple[int, int]] = {}
+        if phased and bool(self.session.get("split_driven_scans")):
+            target = int(self.session.get("split_target_rows") or 65536)
+            for f in fragments:
+                if f.output_kind == "result":
+                    continue
+                sp = scan_split_plan(f.root, self.catalogs, target)
+                if sp is not None:
+                    split_plans[f.id] = sp
+                    ntasks[f.id] = sp[0]
         # durable spooled exchange (reference: ExchangeManager SPI): finished
         # task output commits to this directory; a dead producer's committed
         # output is re-read instead of recomputed, and workers hold no
@@ -1565,6 +1606,11 @@ class Coordinator:
                     self.session.get("compile_deadline_s") or 0.0
                 ),
             }
+            if f.id in split_plans:
+                # split-driven stage: each task is one morsel whose scan
+                # pages pad to this fixed capacity (jit-signature
+                # scale-invariance, exec/compiler.py)
+                payload_base["split_pad_rows"] = split_plans[f.id][1]
             # resumed queries offset the attempt namespace past every
             # journaled pre-crash attempt, so new task ids (and spool
             # staging dirs) never collide with adopted pre-crash tasks
@@ -1657,24 +1703,45 @@ class Coordinator:
                         heal(child)
                     return self._sources_payload(f, frag_by_id, task_urls)
 
-            urls = self._run_stage_phased(
-                payload_base,
-                ntasks[f.id],
-                tag,
-                max_attempts=int(self.session.get("task_retry_attempts")),
-                posted=all_tasks,  # every posted task gets cleaned up
-                refresh_sources=refresh_sources,
-                should_abort=lambda: (
-                    (record.get("kill_reason") or "Query was canceled")
-                    if record.get("cancel")
-                    else None
-                ),
-                on_retry=lambda: record.__setitem__(
-                    "task_retries", record.get("task_retries", 0) + 1
-                ),
-                precommitted=pre or None,
-                on_part_done=on_commit if spool is not None else None,
-            )
+            sched = None
+            max_att = int(self.session.get("task_retry_attempts"))
+            if f.id in split_plans:
+                sched = SplitScheduler(
+                    ntasks[f.id],
+                    queue_depth=int(
+                        self.session.get("split_queue_depth") or 2
+                    ),
+                    is_parked=self._split_parked,
+                )
+                max_att = int(self.session.get("split_retry_limit") or 0) + 1
+            try:
+                urls = self._run_stage_phased(
+                    payload_base,
+                    ntasks[f.id],
+                    tag,
+                    max_attempts=max_att,
+                    posted=all_tasks,  # every posted task gets cleaned up
+                    refresh_sources=refresh_sources,
+                    should_abort=lambda: (
+                        (record.get("kill_reason") or "Query was canceled")
+                        if record.get("cancel")
+                        else None
+                    ),
+                    on_retry=lambda: record.__setitem__(
+                        "task_retries", record.get("task_retries", 0) + 1
+                    ),
+                    precommitted=pre or None,
+                    on_part_done=on_commit if spool is not None else None,
+                    split_sched=sched,
+                )
+            finally:
+                if sched is not None:
+                    sched.close()  # release queued splits from the backlog
+                    with heal_lock:
+                        agg = record.setdefault("split_stats", {})
+                        for k, v in sched.stats.items():
+                            agg[k] = agg.get(k, 0) + v
+                        agg["stages"] = agg.get("stages", 0) + 1
             task_urls[f.id] = urls
             stage_times[f.id] = (t0, time.perf_counter() - t_query0)
             if memo_key is not None:
@@ -1997,6 +2064,19 @@ class Coordinator:
             "trace_id": record.get("trace_id", ""),
             "workers": self.failure_detector.snapshot(),
         }
+        if record.get("split_stats"):
+            # split-plane provenance: rides QueryInfo into history and the
+            # EXPLAIN ANALYZE "-- splits:" footer (runtime/engine.py)
+            ss = dict(record["split_stats"])
+            ss["pad_rows"] = int(
+                1
+                << max(
+                    0,
+                    (int(self.session.get("split_target_rows") or 65536) - 1)
+                    .bit_length(),
+                )
+            )
+            record["query_info"]["splits"] = ss
         if record.get("resumed"):
             # crash-recovery provenance: rides QueryInfo into history and
             # the EXPLAIN ANALYZE "recovery" footer (runtime/engine.py)
@@ -2112,6 +2192,7 @@ class Coordinator:
         on_retry=None,
         precommitted: Optional[dict[int, str]] = None,
         on_part_done=None,
+        split_sched: Optional[SplitScheduler] = None,
     ) -> list[tuple[str, str]]:
         """Post one stage's tasks, poll statuses, and re-schedule individual
         failures onto other alive workers (task-level recovery).  Every
@@ -2144,12 +2225,16 @@ class Coordinator:
         speculated: set[int] = set()  # one backup per part, ever
         backup_worker: dict[int, str] = {}  # part -> backup attempt's worker
         spec_enabled = (
-            bool(self.session.get("speculation_enabled")) and nparts > 1
+            bool(self.session.get("speculation_enabled"))
+            and nparts > 1
+            # split stages speculate via the scheduler's work-stealing
+            # instead (same first-commit-wins arbitration, load-aware)
+            and split_sched is None
         )
         spec_quantile = float(self.session.get("speculation_quantile") or 2.0)
-        # shorter long-poll rounds when speculating: straggler detection
-        # latency is one poll round
-        poll_wait = 1.0 if spec_enabled else 5.0
+        # shorter long-poll rounds when speculating or lazily assigning
+        # splits: detection/assignment latency is one poll round
+        poll_wait = 1.0 if (spec_enabled or split_sched is not None) else 5.0
 
         def try_post(p: int, w: str, task_id: str, payload=None) -> bool:
             if posted is not None:
@@ -2162,6 +2247,22 @@ class Coordinator:
             except Exception:
                 return False  # dead/unreachable worker: reschedule below
 
+        def _dispatchable() -> list[str]:
+            alive = self.alive_workers()
+            d = [w for w in alive if self.failure_detector.is_dispatchable(w)]
+            return d or alive
+
+        def _assign_splits() -> None:
+            # lazy split assignment: drain the scheduler's pool onto
+            # workers with free queue slots (bounded per-worker queues);
+            # splits past every queue wait coordinator-side — that backlog
+            # is the admission-shedding input (runtime/splits.py)
+            for p, w in split_sched.assign(_dispatchable()):
+                task_id = f"{tag}_p{p}_t{attempts[p]}"
+                try_post(p, w, task_id)
+                pending[p] = [(w, task_id)]
+                started[p] = time.monotonic()
+
         for p in range(nparts):
             if precommitted and p in precommitted:
                 # crash recovery: a pre-crash attempt of this part already
@@ -2169,19 +2270,31 @@ class Coordinator:
                 # (SPOOL_URL source) and nothing is posted, the resume
                 # contract's "committed work is never recomputed"
                 urls[p] = (SPOOL_URL, precommitted[p])
+                if split_sched is not None:
+                    split_sched.precommitted(p)
+                continue
+            if split_sched is not None:
+                split_sched.add(p)  # enumerated; posted when a slot frees
                 continue
             w = workers[p % len(workers)]
             task_id = f"{tag}_p{p}_t0"
             try_post(p, w, task_id)
             pending[p] = [(w, task_id)]
             started[p] = time.monotonic()
-        while pending:
+        while pending or (split_sched is not None and split_sched.backlog()):
             if self._killed:
                 raise RuntimeError("coordinator killed")
             if should_abort is not None:
                 msg = should_abort()
                 if msg:
                     raise RuntimeError(msg)
+            if split_sched is not None:
+                _assign_splits()
+                if not pending:
+                    # every candidate worker is parked or full and nothing
+                    # is in flight: wait out the park instead of spinning
+                    time.sleep(0.05)
+                    continue
             polls = [
                 (p, u, t) for p, atts in pending.items() for (u, t) in atts
             ]
@@ -2211,6 +2324,8 @@ class Coordinator:
                             "won" if winner[0] == bw else "lost"
                         ).inc()
                     del pending[p]
+                    if split_sched is not None:
+                        split_sched.on_done(p)  # frees a queue slot
                     continue
                 still = []
                 for a in atts:
@@ -2281,7 +2396,16 @@ class Coordinator:
                     payload_base = dict(
                         payload_base, sources=refresh_sources()
                     )
-                w = alive[(p + attempts[p]) % len(alive)]
+                if split_sched is not None:
+                    # per-split retry: ONLY this morsel re-runs, on the
+                    # least-loaded unparked worker (committed siblings are
+                    # never touched — the spool holds their output)
+                    w = (
+                        split_sched.retry(p, alive, exclude=bad_url)
+                        or alive[(p + attempts[p]) % len(alive)]
+                    )
+                else:
+                    w = alive[(p + attempts[p]) % len(alive)]
                 task_id = f"{tag}_p{p}_t{attempts[p]}"
                 payload_p = payload_base
                 if payload_base.get("memory_budget_bytes"):
@@ -2301,6 +2425,35 @@ class Coordinator:
                 try_post(p, w, task_id, payload_p)
                 pending[p] = [(w, task_id)]
                 started[p] = time.monotonic()
+            if split_sched is not None and pending and durations:
+                # straggler work-stealing: once the pool is dry and a
+                # worker sits idle, a single-attempt split lagging past the
+                # speculation quantile is duplicated onto the idle worker —
+                # same task id, so the spooled exchange's first-commit-wins
+                # rename (or the winner pick above) arbitrates exactly-once
+                median = sorted(durations)[len(durations) // 2]
+                lagging = {
+                    lp
+                    for lp, atts2 in pending.items()
+                    if len(atts2) == 1
+                    and time.monotonic() - started[lp]
+                    > max(0.25, spec_quantile * median)
+                }
+                if lagging:
+                    st = split_sched.steal(_dispatchable(), lagging)
+                    if st is not None:
+                        p, w = st
+                        tid = pending[p][0][1]
+                        if try_post(
+                            p, w, tid,
+                            dict(
+                                payload_base,
+                                attempt=f"st{attempts[p] + 1}",
+                            ),
+                        ):
+                            pending[p].append((w, tid))
+                        else:
+                            split_sched.steal_abort(p, w)
         return urls  # type: ignore[return-value]
 
     def _delete_task_quiet(self, url: str, task_id: str) -> None:
@@ -2562,6 +2715,28 @@ def _make_handler(coord: Coordinator):
                                 "error": (
                                     f"coordinator dispatch queue full "
                                     f"({active} active >= limit {limit}); "
+                                    f"retry later"
+                                )
+                            },
+                            headers={"Retry-After": "1"},
+                        )
+                # split-plane backpressure: bounded per-worker split queues
+                # push back here — when the coordinator-held backlog runs a
+                # full extra round past what the fleet can queue, new
+                # statements shed instead of piling splits behind a stalled
+                # cluster (runtime/splits.py current_backlog)
+                if bool(coord.session.get("split_driven_scans")):
+                    depth = int(coord.session.get("split_queue_depth") or 2)
+                    bound = max(1, len(coord.workers)) * depth * 8
+                    backlog = current_backlog()
+                    if backlog > bound:
+                        coord._m_shed.inc()
+                        return self._send_json(
+                            429,
+                            {
+                                "error": (
+                                    f"split backlog {backlog} exceeds the "
+                                    f"fleet's queue capacity ({bound}); "
                                     f"retry later"
                                 )
                             },

@@ -815,6 +815,23 @@ class Engine:
                 f"re-read from spool: {rec.get('stages_resumed', 0)}, "
                 f"parts re-read: {rec.get('parts_resumed', 0)})"
             )
+        # split footer: present only under split_driven_scans — how many
+        # morsels the scans enumerated and what the scheduler did with
+        # them (runtime/splits.py)
+        spl = info.get("splits") or {}
+        if spl.get("splits"):
+            line = (
+                f"-- splits: {spl.get('splits', 0)} total over "
+                f"{spl.get('stages', 0)} scan stage(s), pad "
+                f"{spl.get('pad_rows', 0)} rows "
+                f"(completed: {spl.get('completed', 0)}, retries: "
+                f"{spl.get('retries', 0)}, steals: {spl.get('steals', 0)}"
+            )
+            if spl.get("precommitted"):
+                line += f", re-read from spool: {spl['precommitted']}"
+            if spl.get("parked"):
+                line += f", park deferrals: {spl['parked']}"
+            text.append(line + ")")
         # fleet footer: present only on queries a surviving fleet member
         # adopted from a dead peer's journal (runtime/fleet.py)
         flt = info.get("fleet") or {}

@@ -348,7 +348,7 @@ class FaultInjector:
 
     MODES = (
         "ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP", "CORRUPT",
-        "MEMORY_PRESSURE", "COMPILE_SLOW", "COMPILE_FAIL",
+        "MEMORY_PRESSURE", "COMPILE_SLOW", "COMPILE_FAIL", "SPLIT_LOST",
     )
 
     def __init__(self):
@@ -398,14 +398,20 @@ class FaultInjector:
         return None
 
     def task_fault(self, task_id: str, sleep: Callable[[float], None] = time.sleep) -> None:
-        """Apply any armed ERROR/TIMEOUT/SLOW fault for this task.
-        Raises RuntimeError for ERROR/TIMEOUT; returns after the delay for
-        SLOW; no-op when nothing matches."""
-        rule = self._take(task_id, ("ERROR", "TIMEOUT", "SLOW"))
+        """Apply any armed ERROR/TIMEOUT/SLOW/SPLIT_LOST fault for this
+        task.  Raises RuntimeError for ERROR/TIMEOUT/SPLIT_LOST; returns
+        after the delay for SLOW; no-op when nothing matches.  SPLIT_LOST
+        models a split assignment evaporating mid-scan (the connector's
+        row range went away under the reader): under split-driven scans
+        exactly ONE morsel fails and is re-assigned alone — a whole-task
+        blast radius here is the regression being tested."""
+        rule = self._take(task_id, ("ERROR", "TIMEOUT", "SLOW", "SPLIT_LOST"))
         if rule is None:
             return
         if rule.mode == "ERROR":
             raise RuntimeError(f"injected failure for task {task_id}")
+        if rule.mode == "SPLIT_LOST":
+            raise RuntimeError(f"split lost for task {task_id} [SPLIT_LOST]")
         if rule.delay_ms:
             sleep(rule.delay_ms / 1000.0)
         if rule.mode == "TIMEOUT":

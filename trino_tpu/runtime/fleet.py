@@ -366,6 +366,15 @@ def _make_router_handler(router: FleetRouter):
             pass
 
         def _reply(self, code: int, body: bytes, headers: dict) -> None:
+            # failover-response contract: ANY backpressure/transient verdict
+            # the router forwards or mints (shed 429s, adoption-window and
+            # member-death 503s, mid-poll 502s) must tell the client WHEN to
+            # come back — a backend that omitted Retry-After gets the
+            # router's 1s default instead of silently dropping the hint
+            if code in (429, 502, 503) and not any(
+                k.lower() == "retry-after" for k in headers
+            ):
+                headers = dict(headers, **{"Retry-After": "1"})
             self.send_response(code)
             for k, v in headers.items():
                 if k.lower() not in _SKIP_HEADERS:
@@ -384,6 +393,7 @@ def _make_router_handler(router: FleetRouter):
             headers.update(extra_headers or {})
             last_err: Optional[Exception] = None
             not_found = None
+            bad_gateway = None
             for i, base in enumerate(targets):
                 if i:
                     FLEET_ROUTER_RETRIES.inc()
@@ -406,6 +416,16 @@ def _make_router_handler(router: FleetRouter):
                         # giving the client a 404
                         not_found = (e.code, payload, dict(e.headers))
                         continue
+                    if e.code == 502 and len(targets) > 1:
+                        # mid-poll Bad Gateway: a member mid-teardown (or a
+                        # front proxy covering one) answered for a query a
+                        # peer may still serve — treat it like a member
+                        # death and try the others, counting the retry like
+                        # any other failover hop; the LAST 502 passes
+                        # through (with Retry-After, _reply's contract) only
+                        # when every member gave the same answer
+                        bad_gateway = (e.code, payload, dict(e.headers))
+                        continue
                     # backpressure (429/503 + Retry-After) and every other
                     # coordinator verdict pass through verbatim
                     self._reply(e.code, router.rewrite(payload), dict(e.headers))
@@ -413,6 +433,12 @@ def _make_router_handler(router: FleetRouter):
                 except OSError as e:  # refused/reset: coordinator death
                     last_err = e
                     continue
+            if bad_gateway is not None and last_err is None:
+                # every member was asked and the best verdict is still a
+                # 502: transient, pass it through (Retry-After added)
+                code, payload, hdrs = bad_gateway
+                self._reply(code, router.rewrite(payload), hdrs)
+                return
             if not_found is not None and last_err is None:
                 # every member answered and none knows the query: a real
                 # 404, not a failover window — pass it through

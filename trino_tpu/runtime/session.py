@@ -312,6 +312,36 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 1,
         ),
         _Prop(
+            "split_driven_scans", bool, False,
+            "enumerate row-range scans as fixed-capacity connector splits "
+            "(runtime/splits.py) and schedule them individually: one task "
+            "per morsel, per-split retry/steal under retry_policy=TASK, "
+            "and scan shapes pinned to split_target_rows so jit signatures "
+            "stop depending on data scale (reference: connector split "
+            "sources lazily scheduled onto drivers)",
+            None,
+        ),
+        _Prop(
+            "split_target_rows", int, 65536,
+            "target rows per scan split; rounded up to a power of two and "
+            "used as the fixed scan-page capacity every morsel pads to, "
+            "making jit signatures scale-invariant",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "split_queue_depth", int, 2,
+            "bounded per-worker queue of assigned-but-unstarted splits; "
+            "when every alive worker's queue is full the scheduler stops "
+            "assigning (backpressure) until a slot frees",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "split_retry_limit", int, 3,
+            "per-split retry budget under split_driven_scans; a split "
+            "failing more times than this fails the query",
+            lambda v: v >= 0,
+        ),
+        _Prop(
             "execute_batch_window_ms", float, 0.0,
             "shared small-query batching: concurrent EXECUTEs of the SAME "
             "prepared plan arriving within this window are stacked into "

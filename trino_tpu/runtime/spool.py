@@ -87,7 +87,12 @@ class SpooledExchange:
         into place — crash-atomic AND first-attempt-wins.  Returns True if
         THIS attempt's output became the committed one, False if another
         attempt already won (the staged bytes are discarded; the winner's
-        chunks, which consumers may be mid-read on, are never touched)."""
+        chunks, which consumers may be mid-read on, are never touched).
+
+        This rename is also the exactly-once arbiter for split-driven scans
+        (runtime/splits.py): a stolen morsel re-posts under the SAME task
+        id as the straggler it duplicates, so however many attempts race,
+        exactly one morsel output publishes and the losers vanish here."""
         tdir = os.path.join(self.dir, task_id)
         if self.is_committed(task_id):
             return False

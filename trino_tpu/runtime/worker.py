@@ -281,9 +281,19 @@ class Worker:
             if u.strip()
         ]
         # periodic re-announce cadence (0 disables); first announce fires
-        # one interval after start — the initial registration is explicit
+        # about one interval after start — the initial registration is
+        # explicit.  Decorrelated jitter: every worker announces to EVERY
+        # fleet member, so a restarted member would otherwise receive the
+        # whole fleet's announces in one synchronized wave each interval
         self.announce_interval_s = 2.0
-        self._next_announce = time.monotonic() + self.announce_interval_s
+        # unit-interval decorrelated walk in [0.5, 1.5], scaled by the
+        # CURRENT announce_interval_s at each tick (tests shorten it live)
+        self._announce_backoff = Backoff(
+            min_delay=0.5, max_delay=1.5, decorrelated=True
+        )
+        self._next_announce = time.monotonic() + (
+            self.announce_interval_s * self._announce_backoff.delay()
+        )
         self._monitor_stop = threading.Event()
         self._monitor = threading.Thread(target=self._watchdog_loop, daemon=True)
         self._pool = ThreadPoolExecutor(max_workers=task_concurrency)
@@ -524,7 +534,9 @@ class Worker:
                 and self.announce_interval_s > 0
                 and now >= self._next_announce
             ):
-                self._next_announce = now + self.announce_interval_s
+                self._next_announce = now + (
+                    self.announce_interval_s * self._announce_backoff.delay()
+                )
                 self._announce()
             with self._lock:
                 tasks = list(self.tasks.values())
@@ -640,6 +652,11 @@ class Worker:
         task.progress()
         executor = LocalExecutor(self.catalogs, self.default_catalog)
         executor.split = (req["part"], req["num_parts"])
+        if req.get("split_pad_rows"):
+            # split-driven scan (runtime/splits.py): this task IS one
+            # fixed-capacity morsel — every scan page pads to the same
+            # capacity regardless of data scale
+            executor.split_pad_rows = int(req["split_pad_rows"])
         executor.collect_operator_stats = True
         if req.get("memory_budget_bytes"):
             executor.memory_budget_bytes = int(req["memory_budget_bytes"])
@@ -709,7 +726,15 @@ class Worker:
         out_kind = req["output_kind"]
         out_parts = req["out_parts"]
         spill_ms = 0.0
-        revoked = task.revoke_requested and not req.get("analyze")
+        # a split-driven task is already a single bounded morsel: re-slicing
+        # it 4x buys nothing (the working set is the pad capacity either
+        # way) — the coordinator honors the revocation instead by PARKING
+        # the worker's queued splits (runtime/splits.py)
+        revoked = (
+            task.revoke_requested
+            and not req.get("analyze")
+            and not req.get("split_pad_rows")
+        )
         if req.get("analyze"):
             # distributed EXPLAIN ANALYZE: the eager node-hook pass adds
             # per-operator wall ms on top of the exact row counts

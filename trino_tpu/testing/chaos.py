@@ -21,6 +21,7 @@ from .runner import DistributedQueryRunner
 
 __all__ = [
     "ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES", "COMPILE_MODES",
+    "SPLIT_MODES",
 ]
 
 # modes that a retry_policy=TASK cluster must absorb without losing the
@@ -44,6 +45,14 @@ CORRUPTION_MODES = RECOVERABLE_MODES + ("CORRUPT",)
 # replay identically; pass modes=COMPILE_MODES (or RECOVERABLE_MODES +
 # COMPILE_MODES) to arm it.
 COMPILE_MODES = ("COMPILE_SLOW", "COMPILE_FAIL")
+
+# opt-in: split-plane chaos (runtime/splits.py).  SPLIT_LOST raises inside
+# one task's execution hook — under split_driven_scans a task IS one
+# morsel, so exactly that split retries on another worker while every
+# committed sibling is left alone.  A separate tuple — not folded into
+# RECOVERABLE_MODES — so existing seeded schedules replay identically;
+# pass modes=RECOVERABLE_MODES + SPLIT_MODES to arm it alongside the rest.
+SPLIT_MODES = ("SPLIT_LOST",)
 
 
 class ChaosRunner:
