@@ -584,12 +584,20 @@ def _bench_multi_scale(deadline) -> dict:
     per scale and query: split count, split retries, wall time, and the
     number of distinct jit signatures the run touched — the tentpole claim
     is that the split COUNT moves with data while the signature count does
-    NOT (``signature_invariant`` per query).  Informational only:
-    scripts/perf_gate.py ignores this block by design.
+    NOT (``signature_invariant`` per query).  Each scale also reports the
+    storage-pressure counters from the workers' governed disk pools
+    (``disk``: spool/spill peak bytes, pressure reclaims, reclaimed
+    bytes, typed sheds) — at sf10 the spool grows ~100x, and these show
+    whether the run lived off reclaim or started shedding.  Informational
+    only: scripts/perf_gate.py ignores this block by design.
 
     Knobs: BENCH_MS_SFS (default "0.01,0.02"), BENCH_MS_QUERIES (default
-    "q01,q06"), BENCH_MS_TARGET_ROWS (default 8192).
+    "q01,q06"), BENCH_MS_TARGET_ROWS (default 8192), BENCH_MS_DISK_BUDGET
+    (per-worker disk pool bytes, default 1 GiB).
     """
+    import shutil
+    import tempfile
+
     from trino_tpu.connectors.tpch import TpchConnector
     from trino_tpu.testing import DistributedQueryRunner
     from trino_tpu.utils.profiler import PROFILER
@@ -599,6 +607,7 @@ def _bench_multi_scale(deadline) -> dict:
     qnames = [q for q in
               os.environ.get("BENCH_MS_QUERIES", "q01,q06").split(",") if q]
     target = int(os.environ.get("BENCH_MS_TARGET_ROWS", "8192"))
+    disk_budget = int(os.environ.get("BENCH_MS_DISK_BUDGET", str(1 << 30)))
 
     def uses(e):
         return (e.get("executes", 0) + e.get("compiles", 0)
@@ -611,12 +620,15 @@ def _bench_multi_scale(deadline) -> dict:
             out["scales"][str(sf)] = {"skipped": "deadline"}
             continue
         runner = DistributedQueryRunner(
-            num_workers=2, default_catalog="tpch", heartbeat_interval=0.5
+            num_workers=2, default_catalog="tpch", heartbeat_interval=0.5,
+            disk_budget_bytes=disk_budget,
         )
         runner.register_catalog("tpch", TpchConnector(sf))
         runner.start()
+        spool_dir = tempfile.mkdtemp(prefix="bench_ms_spool_")
         s = runner.coordinator.session
         s.set("retry_policy", "TASK")
+        s.set("exchange_spool_dir", spool_dir)
         s.set("split_driven_scans", "true")
         s.set("split_target_rows", str(target))
         per_scale: dict = {}
@@ -649,7 +661,20 @@ def _bench_multi_scale(deadline) -> dict:
         except Exception as e:
             per_scale["error"] = str(e)[:200]
         finally:
+            # storage pressure for the whole scale: max peak across the
+            # workers' disk pools, summed reclaim/shed counters
+            disk = {"budget_bytes": disk_budget, "peak_bytes": 0,
+                    "reclaims": 0, "reclaimed_bytes": 0, "sheds": 0}
+            for w in runner.workers:
+                if getattr(w, "disk_pool", None) is not None:
+                    snap = w.disk_pool.snapshot()
+                    disk["peak_bytes"] = max(disk["peak_bytes"], snap["peak"])
+                    disk["reclaims"] += snap["reclaims"]
+                    disk["reclaimed_bytes"] += snap["reclaimed_bytes"]
+                    disk["sheds"] += snap["sheds"]
+            per_scale["disk"] = disk
             runner.stop()
+            shutil.rmtree(spool_dir, ignore_errors=True)
         out["scales"][str(sf)] = per_scale
     out["signature_invariant"] = {
         q: len(set(c)) == 1 for q, c in sig_counts.items() if len(c) > 1

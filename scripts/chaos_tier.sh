@@ -30,6 +30,15 @@
 #           plus SPLIT_LOST injection, the jit-signature scale-invariance
 #           witness for tpch q01/q06 at two data scales, and the at-scale
 #           kill drill on tpch lineitem (CHAOS_SF, default sf1)
+# Storage-pressure chaos (tests/test_disk_governance.py):
+#   disk    DISK_FULL pool shrink on one node mid-query (reclaim -> block
+#           -> typed EXCEEDED_SPILL_LIMIT shed, retry rotates away) and
+#           SPOOL_LOST committed-partition loss (coordinator reproduces
+#           the producer under first-commit-wins, zero client-visible
+#           failures, spool_reproductions_total > 0); disk-pool lease
+#           accounting, ENOSPC conversion, reclaim escalation order.
+#           CI runs at sf0.1-equivalent row counts; set CHAOS_SF to crank
+#           the at-scale drill (sf10 is the acceptance bar on big hosts)
 # Coordinator-fleet chaos (tests/test_fleet.py):
 #   fleet   kill one coordinator of a two-member fleet mid multi-stage
 #           query — a peer adopts it off the dead member's journal
@@ -78,6 +87,11 @@ case "${1:-}" in
   splits)
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_splits.py -q \
+        -p no:cacheprovider "$@"
+    ;;
+  disk)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_disk_governance.py -q \
         -p no:cacheprovider "$@"
     ;;
   fleet)

@@ -5,8 +5,11 @@
 # and compile-signature attribution, Prometheus /metrics on coordinator
 # AND workers (linted against the README via scripts/metrics_lint.py),
 # the /v1/query listing + /v1/query/{id} QueryInfo endpoints with the
-# history fallback after expiry, and traceparent propagation into worker
-# task spans.
+# history fallback after expiry, traceparent propagation into worker
+# task spans, and the storage-governance plane — trino_tpu_disk_pool_*
+# gauges on governed workers and a nonzero
+# trino_tpu_spool_reproductions_total after SPOOL_LOST injection (the
+# self-healing spool actually healing).
 #
 # Fast enough to run on every runtime/ or exec/ change; the same checks
 # run under the tier-1 gate via tests/test_obs_plane.py.
@@ -28,7 +31,9 @@ def get(url):
         return resp.read().decode()
 
 
-runner = DistributedQueryRunner(num_workers=2)
+# a disk budget gives every worker a governed NodeDiskPool, so the
+# trino_tpu_disk_pool_* gauges below have something to report
+runner = DistributedQueryRunner(num_workers=2, disk_budget_bytes=64 << 20)
 runner.register_catalog("tpch", TpchConnector(0.01))
 runner.start()
 try:
@@ -192,6 +197,38 @@ try:
     )
     print(f"splits completed counter: {done[0].split()[-1]}")
     coord.session.set("split_driven_scans", "false")
+
+    # storage-governance plane (runtime/disk.py + the self-healing spool):
+    # every governed worker must expose the disk-pool gauges, and a
+    # SPOOL_LOST injection on a committed partition must drive a producer
+    # reproduction — visible as a nonzero spool_reproductions_total
+    for w in runner.workers:
+        wtext = get(f"{w.url}/metrics")
+        cap = [
+            ln for ln in wtext.splitlines()
+            if ln.startswith("trino_tpu_disk_pool_capacity_bytes{")
+        ]
+        assert cap and float(cap[0].split()[-1]) > 0, (
+            f"expected a governed disk pool on {w.url}: {cap}"
+        )
+    print(f"disk pool gauges: {len(runner.workers)} workers governed ok")
+
+    for i in range(len(runner.workers)):
+        runner.inject_task_failure(i, mode="SPOOL_LOST")
+    runner.query("select l_linestatus, sum(l_quantity) from lineitem "
+                 "group by l_linestatus order by l_linestatus")
+    for w in runner.workers:
+        w.fault_injector.clear()
+    mtext3 = get(base + "/metrics")
+    repro = [
+        ln for ln in mtext3.splitlines()
+        if ln.startswith("trino_tpu_spool_reproductions_total")
+        and not ln.startswith("#")
+    ]
+    assert repro and float(repro[0].split()[-1]) > 0, (
+        f"expected a nonzero spool-reproduction counter: {repro}"
+    )
+    print(f"spool reproductions counter: {repro[0].split()[-1]}")
 finally:
     runner.stop()
 

@@ -133,12 +133,47 @@ def test_order_by_decimal128(d128_engine):
     assert [int(r[0]) for r in rows] == sorted(BIG, reverse=True)[:3]
 
 
+def test_join_on_decimal128_keys(d128_engine):
+    """Equi-join keys on two-limb lanes compare at full 128-bit width: the
+    exact-equality verify checks BOTH limbs, so values that collide on the
+    lo limb alone never match (and the gathers carry the hi limb through)."""
+    rows = d128_engine.query(
+        "select a.k, b.y from big a join big b on a.x = b.x order by b.y"
+    )
+    # every BIG value is unique: the self-join matches each row exactly once
+    assert len(rows) == len(BIG)
+    assert sorted(int(r[1]) for r in rows) == sorted(v + 1 for v in BIG)
+
+
+def test_join_carries_decimal128_payload(d128_engine):
+    """A decimal128 VALUE column rides the join's expansion gathers and
+    aggregates exactly on the far side."""
+    rows = d128_engine.query(
+        "select sum(b.y) from big a join big b on a.x = b.x"
+    )
+    assert int(rows[0][0]) == sum(v + 1 for v in BIG)
+
+
+def test_case_over_decimal128(d128_engine):
+    """CASE selects over both limbs; the single-lane 0 literal in the ELSE
+    branch sign-extends into limb space."""
+    rows = d128_engine.query(
+        "select sum(case when k = 0 then x else 0 end) from big"
+    )
+    assert int(rows[0][0]) == sum(v for i, v in enumerate(BIG) if i % 2 == 0)
+
+
+def test_max_over_decimal128(d128_engine):
+    """min/max reduce lexicographically over (hi signed, lo unsigned)."""
+    rows = d128_engine.query("select max(x), min(x) from big")
+    assert int(rows[0][0]) == max(BIG)
+    assert int(rows[0][1]) == min(BIG)
+
+
 def test_unsupported_ops_refuse_loudly(d128_engine):
     with pytest.raises(Exception):
-        # join keys on decimal128 lanes are still a loud refusal
-        d128_engine.query(
-            "select a.k from big a join big b on a.x = b.x"
-        )
+        # window functions over decimal128 lanes are still a loud refusal
+        d128_engine.query("select sum(x) over () from big")
 
 
 def test_mul128(d128_engine):

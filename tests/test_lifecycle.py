@@ -280,6 +280,11 @@ def test_speculation_backup_wins(tmp_path):
         # warm-up (JIT) before timing anything
         assert runner.query(JOIN_SQL) == [(expect,)]
 
+        # whole-task speculation is the machinery under test; split-driven
+        # stages (the default since the storage-governance release) handle
+        # stragglers by split STEALING instead and never speculate, so this
+        # test pins the classic whole-scan path
+        coord.session.set("split_driven_scans", "false")
         coord.session.set("speculation_enabled", "true")
         coord.session.set("speculation_quantile", "1.5")
         runner.inject_task_failure(worker_index=0, mode="SLOW",

@@ -44,6 +44,28 @@ from trino_tpu.testing import DistributedQueryRunner
 pytestmark = pytest.mark.smoke
 
 
+@pytest.fixture(autouse=True)
+def _whole_scan_tasks(monkeypatch):
+    """This file asserts the classic whole-scan memory plane: eager
+    round-robin task dispatch (every worker gets a task whose lease the
+    asserts watch) and REVOKE_SPILL_PARTS sliced re-execution under a
+    revoked lease.  split_driven_scans — ON by default since the
+    storage-governance release — replaces both with morsel scheduling
+    (lazy least-loaded placement, parked revocations), whose memory
+    interactions tests/test_splits.py covers.  Pin the classic path."""
+    import dataclasses
+
+    from trino_tpu.runtime import session as session_mod
+
+    monkeypatch.setitem(
+        session_mod.PROPERTIES,
+        "split_driven_scans",
+        dataclasses.replace(
+            session_mod.PROPERTIES["split_driven_scans"], default=False
+        ),
+    )
+
+
 def _wait(pred, timeout=30.0, interval=0.02):
     deadline = time.monotonic() + timeout
     while not pred():

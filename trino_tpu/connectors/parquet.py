@@ -137,6 +137,7 @@ class ParquetConnector(Connector):
         self.generation = 0  # bumped on writes; executor cache key component
         self._schema_cache: dict[str, TableSchema] = {}
         self._split_plan: dict[tuple[str, int], list[list[_FileGroup]]] = {}
+        self._unit_plan: dict[str, Optional[tuple[int, int]]] = {}
         self._declared: dict[str, TableSchema] = {}  # CREATE TABLE, no files yet
 
     # ----------------------------------------------------------- metadata
@@ -185,6 +186,30 @@ class ParquetConnector(Connector):
         for path in self._table_files(table):
             total += pa.parquet.ParquetFile(path).metadata.num_rows
         return total
+
+    def scan_unit_plan(self, table: str) -> Optional[tuple[int, int]]:
+        """File-backed split sizing for runtime/splits.py scan_split_plan:
+        ``(n_units, max_unit_rows)`` over this table's (file, row-group)
+        units.  A split-driven stage that picks ``nsplits = n_units`` gets
+        exactly ONE unit per bucket from get_splits — the scan streams the
+        partitioned parquet dir file-by-file (row-group by row-group) under
+        the ordinary split retry/steal/park machinery, and every morsel's
+        scan page pads to a capacity covering the fattest row group."""
+        if table not in self._unit_plan:
+            pa = _pa()
+            try:
+                files = self._table_files(table)
+            except FileNotFoundError:
+                files = []
+            n = 0
+            max_rows = 0
+            for path in files:
+                md = pa.parquet.ParquetFile(path).metadata
+                for rg in range(md.num_row_groups):
+                    n += 1
+                    max_rows = max(max_rows, md.row_group(rg).num_rows)
+            self._unit_plan[table] = (n, max_rows) if n else None
+        return self._unit_plan[table]
 
     # -------------------------------------------------------------- scans
     def get_splits(self, table: str, desired_parts: int) -> list[Split]:
@@ -269,6 +294,7 @@ class ParquetConnector(Connector):
     def _invalidate(self, table: str) -> None:
         self.generation += 1
         self._split_plan = {k: v for k, v in self._split_plan.items() if k[0] != table}
+        self._unit_plan.pop(table, None)
 
 
 def _column_to_numpy(chunked, t: Type) -> np.ndarray:

@@ -727,13 +727,17 @@ class LocalExecutor:
                         "cost": cost}
 
             # the service key spans executors: (signature, stats mode,
-            # pytree structure, avals).  The treedef hashes trace-time
-            # Dictionary objects BY IDENTITY (data/page.py), so a shared
-            # program can never decode strings through another input's
-            # dictionary.
+            # pytree structure, avals, kernel policy).  The treedef hashes
+            # trace-time Dictionary objects BY IDENTITY (data/page.py), so a
+            # shared program can never decode strings through another
+            # input's dictionary; the policy fingerprint keeps a program
+            # traced under one kernel policy (e.g. interpreted f32 segsums)
+            # from swapping in for an executor running another.
+            from ..ops.kernels import policy_key
+
             budget_ms = int(self.compile_wait_budget_ms or 0)
             out = svc.obtain(
-                (sig, collect, treedef, avals), sig, build,
+                (sig, collect, treedef, avals, policy_key()), sig, build,
                 wait_budget_s=(budget_ms / 1e3) if budget_ms > 0 else None,
                 deadline_s=float(self.compile_deadline_s or 0.0),
                 injector=self.fault_injector,
@@ -1009,9 +1013,10 @@ def _trace_plan(
         return stage
 
     def check_limbed(stage: _Stage, what: str) -> _Stage:
-        # v1 decimal128 surface: scan -> filter/project -> aggregate.  Ops
-        # that re-gather columns would silently drop the high limb, so they
-        # refuse loudly instead (Int128 paths widen per-operator over time)
+        # decimal128 surface: scan -> filter/project -> join -> aggregate
+        # (+ CASE, sort/topn gathers).  The remaining ops that re-gather
+        # columns would silently drop the high limb, so they refuse loudly
+        # instead (Int128 paths widen per-operator over time)
         if any(cv.data2 is not None for cv in stage.cols):
             raise NotImplementedError(f"decimal128 columns through {what}")
         return stage
@@ -1199,8 +1204,11 @@ def _trace_plan(
             return _Stage(cols, out_live)
 
         if isinstance(node, Join):
-            left = check_limbed(emit(node.left), "join")
-            right = check_limbed(emit(node.right), "join")
+            # decimal128 columns ride the join: the expansion gathers, the
+            # left/full null-extension concats, and the exact key equality
+            # all carry/compare the high limb (ops/relops.py equi_join)
+            left = emit(node.left)
+            right = emit(node.right)
             if node.kind == "cross":
                 cols, live = broadcast_single_row(
                     left.cols, left.live, right.cols, right.live

@@ -44,6 +44,7 @@ from ..data.page import Page
 from ..plan.distribute import distribute
 from ..plan.fragmenter import fragment_plan
 from ..plan.nodes import PlanNode, TableScan
+from ..runtime.disk import guarded_write
 from ..runtime.wire import partition_page, page_to_wire_chunks, wire_to_page
 from .compiler import LocalExecutor, _node_ids
 
@@ -88,12 +89,16 @@ class OutOfCoreExecutor:
         parts: int,
         session=None,
         spill_dir: Optional[str] = None,
+        disk_pool=None,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
         self.parts = max(2, parts)
         self.session = session
         self.spill_dir = spill_dir
+        # optional runtime/disk.py NodeDiskPool: spill chunks lease bytes
+        # against the node budget before writing (typed shed, never ENOSPC)
+        self.disk_pool = disk_pool
         self.spilled_bytes = 0
         self.spill_files = 0
 
@@ -119,8 +124,14 @@ class OutOfCoreExecutor:
             for blob in chunks:
                 path = os.path.join(tmp, f"s{seq[0]}.page")
                 seq[0] += 1
-                with open(path, "wb") as fh:
-                    fh.write(blob)
+                if self.disk_pool is not None:
+                    # leased against the node disk budget; the path makes
+                    # the lease self-releasing once the spill dir is gone
+                    self.disk_pool.reserve(
+                        os.path.basename(path), len(blob),
+                        what="out-of-core spill", path=path,
+                    )
+                guarded_write(path, blob)
                 self.spilled_bytes += len(blob)
                 self.spill_files += 1
                 _SPILL_BYTES.inc(len(blob))

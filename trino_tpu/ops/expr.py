@@ -1452,8 +1452,6 @@ def _kleene(op: str, e: Call, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
 def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
     if e.default is not None:
         out = eval_expr(e.default, cols, n)
-        if out.data2 is not None:
-            raise NotImplementedError("decimal128 through CASE")
     else:
         out = ColumnVal(
             jnp.zeros((n,), dtype=_np_to_jnp(e.type)),
@@ -1464,8 +1462,11 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
     evaluated = [
         (eval_expr(cond, cols, n), eval_expr(res, cols, n)) for cond, res in e.whens
     ]
-    if any(r.data2 is not None for _, r in evaluated):
-        raise NotImplementedError("decimal128 through CASE")
+    # decimal128 CASE: select over BOTH limbs; single-lane branches (narrow
+    # decimal literals like 0) sign-extend into limb space via _as_limbs
+    limbed = out.data2 is not None or any(
+        r.data2 is not None for _, r in evaluated
+    )
     if out.dict is not None or any(r.dict is not None for _, r in evaluated):
         # varchar CASE: union the branch dictionaries on the host, remap each
         # branch's codes into union space, select codes on device — the same
@@ -1501,11 +1502,19 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
         evaluated = [(c, remap(r)) for c, r in evaluated]
     out_data, out_valid = out.data, out.valid
     result_dict = out.dict
+    out_hi = None
+    if limbed:
+        out_data, out_hi = _as_limbs(out)
     for c, r in reversed(evaluated):
         cm = c.data.astype(jnp.bool_)
         if c.valid is not None:
             cm = cm & c.valid
-        out_data = jnp.where(cm, r.data.astype(out_data.dtype), out_data)
+        if limbed:
+            rlo, rhi = _as_limbs(r)
+            out_data = jnp.where(cm, rlo, out_data)
+            out_hi = jnp.where(cm, rhi, out_hi)
+        else:
+            out_data = jnp.where(cm, r.data.astype(out_data.dtype), out_data)
         rv = _valid_mask(r) if r.valid is not None else None
         if out_valid is None and rv is None:
             out_valid = None
@@ -1513,7 +1522,7 @@ def _case(e: CaseWhen, cols: Sequence[ColumnVal], n: int) -> ColumnVal:
             ov = out_valid if out_valid is not None else jnp.ones((n,), jnp.bool_)
             rvm = rv if rv is not None else jnp.ones((n,), jnp.bool_)
             out_valid = jnp.where(cm, rvm, ov)
-    return ColumnVal(out_data, out_valid, result_dict, e.type)
+    return ColumnVal(out_data, out_valid, result_dict, e.type, data2=out_hi)
 
 
 def _as_limbs(v: ColumnVal):

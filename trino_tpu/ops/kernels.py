@@ -75,9 +75,16 @@ def set_policy(policy: KernelPolicy) -> None:
 
 def policy_key() -> tuple:
     """Fingerprint for executor jit-cache keys: a changed policy must compile
-    a new program (the kernel choice is baked into the trace)."""
+    a new program (the kernel choice is baked into the trace).  The pallas
+    module-level overrides ride along because they too are read at trace
+    time: compiled programs outlive them in the process-global
+    CompileService done-map, and an interpreted (f32-matmul) segsum program
+    must never be swapped in for an exact-f64 request with the same avals."""
+    from .pallas import hashagg, segreduce, topk
+
     p = _POLICY
-    return (p.enabled, p.hash_agg_max_groups, p.hash_join_max_build, p.interpret)
+    return (p.enabled, p.hash_agg_max_groups, p.hash_join_max_build,
+            p.interpret, segreduce.INTERPRET, hashagg.INTERPRET, topk.FORCE)
 
 
 # --------------------------------------------------------- event capture

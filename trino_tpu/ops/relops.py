@@ -572,9 +572,12 @@ def _fused_aggs(
     for arg, arg2, spec in zip(agg_args, agg_args2, specs):
         if any(
             v is not None and v.data2 is not None for v in (arg, arg2)
-        ) and not (spec.fn in ("sum", "count") and not spec.distinct):
+        ) and not (
+            spec.fn in ("sum", "count", "min", "max") and not spec.distinct
+        ):
             raise NotImplementedError(
-                f"aggregate {spec.fn} over decimal128 lanes (sum/count only)"
+                f"aggregate {spec.fn} over decimal128 lanes "
+                f"(sum/count/min/max only)"
             )
         if (
             spec.distinct
@@ -645,9 +648,26 @@ def _fused_aggs(
                  add(SegRed("sum", l1, valid)), add(SegRed("sum", l2, valid)),
                  add(SegRed("sum", l3, valid)), add_count(valid))
             )
+        elif arg.data2 is not None and spec.fn in ("min", "max"):
+            # decimal128 min/max: lexicographic two-pass — the fused pass
+            # reduces the SIGNED hi limb; a follow-up segmented pass picks
+            # the best UNSIGNED lo limb among rows whose hi limb equals the
+            # group winner (Int128 compare order = (hi, unsigned lo);
+            # reference: spi/type/Int128Math.compare).  The lo limb is
+            # XOR-biased so unsigned order matches int64 signed order.
+            hi = arg.data2 if perm is None else jnp.take(arg.data2, perm)
+            lo_b = jnp.bitwise_xor(
+                data.astype(jnp.int64), jnp.int64(-(2 ** 63))
+            )
+            recipe.append(
+                ("minmax128", spec.fn,
+                 add(SegRed(spec.fn, hi.astype(jnp.int64), valid)),
+                 add_count(valid), lo_b, valid, hi.astype(jnp.int64))
+            )
         elif arg.data2 is not None:
             raise NotImplementedError(
-                f"aggregate {spec.fn} over decimal128 lanes (sum/count only)"
+                f"aggregate {spec.fn} over decimal128 lanes "
+                f"(sum/count/min/max only)"
             )
         elif spec.fn in ("sum", "avg"):
             as_int = spec.fn == "sum" and jnp.issubdtype(data.dtype, jnp.integer)
@@ -716,6 +736,22 @@ def _fused_aggs(
         elif kind == "minmax":
             s, cnt = results[r[1]], results[r[2]]
             out.append((s, cnt > 0))
+        elif kind == "minmax128":
+            _, fn, hi_i, ci, lo_b, valid_m, hi_rows = r
+            hi_g, cnt = results[hi_i], results[ci]
+            # second pass: best biased lo limb restricted to the rows whose
+            # hi limb equals their group's winning hi limb
+            at_best = valid_m & (
+                hi_rows == jnp.take(hi_g.astype(jnp.int64), seg)
+            )
+            lo_best = fused_segment_reduce(
+                seg, [SegRed(fn, lo_b, at_best)], G,
+                sorted_segments=sorted_segments, boundaries=boundaries,
+            )[0]
+            lo_g = jnp.bitwise_xor(
+                lo_best.astype(jnp.int64), jnp.int64(-(2 ** 63))
+            )
+            out.append((lo_g, cnt > 0, None, hi_g.astype(jnp.int64)))
         elif kind == "bool":
             s, cnt = results[r[1]], results[r[2]]
             out.append((s > 0, cnt > 0))
@@ -1332,7 +1368,10 @@ def equi_join(
     bidx = jnp.take(perm_b, bpos_c)
     in_range = j < total
 
-    # exact key verification (hash collisions + sentinel lanes)
+    # exact key verification (hash collisions + sentinel lanes); decimal128
+    # keys verify BOTH limbs — the combined hash folds only the lo limb, so
+    # hi-limb collisions must be filtered here (a single-lane side
+    # sign-extends into limb space, reference: spi/type/Int128Math.java)
     eq = in_range
     for lk, rk in zip(left_keys, right_keys):
         lv = jnp.take(lk.data, pidx_c)
@@ -1340,8 +1379,21 @@ def equi_join(
         lval = jnp.take(_valid_of(lk, nl), pidx_c)
         rval = jnp.take(_valid_of(rk, nr), bidx)
         eq = eq & (lv == rv) & lval & rval
+        if lk.data2 is not None or rk.data2 is not None:
+            lhi = (
+                jnp.take(lk.data2, pidx_c)
+                if lk.data2 is not None
+                else lv.astype(jnp.int64) >> 63
+            )
+            rhi = (
+                jnp.take(rk.data2, bidx)
+                if rk.data2 is not None
+                else rv.astype(jnp.int64) >> 63
+            )
+            eq = eq & (lhi == rhi)
 
-    # gather both sides into the expansion frame
+    # gather both sides into the expansion frame (decimal128 columns carry
+    # their high limb through the gather)
     gathered: list[ColumnVal] = []
     for cv in left_cols:
         gathered.append(
@@ -1350,6 +1402,7 @@ def equi_join(
                 None if cv.valid is None else jnp.take(cv.valid, pidx_c),
                 cv.dict,
                 cv.type,
+                None if cv.data2 is None else jnp.take(cv.data2, pidx_c),
             )
         )
     for cv in right_cols:
@@ -1359,6 +1412,7 @@ def equi_join(
                 None if cv.valid is None else jnp.take(cv.valid, bidx),
                 cv.dict,
                 cv.type,
+                None if cv.data2 is None else jnp.take(cv.data2, bidx),
             )
         )
     match = eq
@@ -1414,6 +1468,11 @@ def equi_join(
         out: list[ColumnVal] = []
         for i, cv in enumerate(left_cols):
             data = jnp.concatenate([gathered[i].data, cv.data])
+            data2 = (
+                None
+                if cv.data2 is None
+                else jnp.concatenate([gathered[i].data2, cv.data2])
+            )
             valid = (
                 None
                 if cv.valid is None and not full
@@ -1429,13 +1488,20 @@ def equi_join(
             if full:
                 data = jnp.concatenate([data, jnp.zeros((nr,), cv.data.dtype)])
                 valid = jnp.concatenate([valid, jnp.zeros((nr,), jnp.bool_)])
-            out.append(ColumnVal(data, valid, cv.dict, cv.type))
+                if data2 is not None:
+                    data2 = jnp.concatenate([data2, jnp.zeros((nr,), data2.dtype)])
+            out.append(ColumnVal(data, valid, cv.dict, cv.type, data2))
         off = len(left_cols)
         for i, cv in enumerate(right_cols):
             g = gathered[off + i]
             gv = g.valid if g.valid is not None else jnp.ones((C,), jnp.bool_)
             data = jnp.concatenate([g.data, jnp.zeros((nl,), cv.data.dtype)])
             valid = jnp.concatenate([gv, jnp.zeros((nl,), jnp.bool_)])
+            data2 = (
+                None
+                if cv.data2 is None
+                else jnp.concatenate([g.data2, jnp.zeros((nl,), cv.data2.dtype)])
+            )
             if full:
                 data = jnp.concatenate([data, cv.data])
                 valid = jnp.concatenate(
@@ -1444,7 +1510,9 @@ def equi_join(
                         cv.valid if cv.valid is not None else jnp.ones((nr,), jnp.bool_),
                     ]
                 )
-            out.append(ColumnVal(data, valid, cv.dict, cv.type))
+                if data2 is not None:
+                    data2 = jnp.concatenate([data2, cv.data2])
+            out.append(ColumnVal(data, valid, cv.dict, cv.type, data2))
         out_live = jnp.concatenate([match, unmatched])
         if full:
             out_live = jnp.concatenate([out_live, unmatched_r])
@@ -1472,7 +1540,12 @@ def broadcast_single_row(
             valid = jnp.broadcast_to(any_right, (nl,))
         else:
             valid = jnp.broadcast_to(cv.valid[ridx] & any_right, (nl,))
-        out.append(ColumnVal(data, valid, cv.dict, cv.type))
+        data2 = (
+            None
+            if cv.data2 is None
+            else jnp.full((nl,), cv.data2[ridx], dtype=cv.data2.dtype)
+        )
+        out.append(ColumnVal(data, valid, cv.dict, cv.type, data2))
     return out, left_live
 
 

@@ -18,6 +18,10 @@ Recognized config.properties keys:
     query.max-memory-per-node=...   bytes; becomes query_max_memory_bytes
     memory.heap-headroom-per-node   bytes; cluster_memory_limit_bytes
     exchange.spool-dir=/path        durable spooled exchange directory
+    spool.disk-budget-bytes=...     per-node disk budget for spool + spill
+                                    writes (runtime/disk.py; 0 = ungoverned)
+    spool.disk-blocked-timeout-s=10 blocked-on-disk park time before the
+                                    typed EXCEEDED_SPILL_LIMIT shed
     retry-policy=NONE|QUERY|TASK    default retry policy
     task.concurrency=4              worker executor pool width
     query.journal-path=/path        durable query journal (crash recovery)
@@ -129,6 +133,14 @@ class NodeConfig:
         # (runtime/memory.py) — task reservations are carved from it
         self.node_memory_bytes = self.cluster_memory_limit_bytes
         self.exchange_spool_dir = props.get("exchange.spool-dir", "")
+        # disk governance (runtime/disk.py NodeDiskPool): spool commits and
+        # spill files lease bytes against this per-node budget; 0 = ungoverned
+        self.disk_budget_bytes = int(props.get("spool.disk-budget-bytes", "0"))
+        # how long a writer parks on a full disk pool (after reclaim) before
+        # shedding with the typed EXCEEDED_SPILL_LIMIT
+        self.disk_blocked_timeout_s = float(
+            props.get("spool.disk-blocked-timeout-s", "10")
+        )
         self.retry_policy = props.get("retry-policy", "NONE")
         self.task_concurrency = int(props.get("task.concurrency", "4"))
         self.journal_path = props.get("query.journal-path", "")

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import traceback
@@ -65,6 +66,11 @@ from .wire import wire_to_page
 
 __all__ = ["Coordinator"]
 
+# typed marker a consuming worker raises when a producer's COMMITTED spool
+# partition turns out missing or corrupt at read time (runtime/worker.py):
+# the captured group is the producer task id to reproduce
+_SPOOL_LOST_RE = re.compile(r"SPOOL_LOST:([A-Za-z0-9_.\-]+):")
+
 
 def _json_default(o):
     """Result rows can hold decimal.Decimal (long-decimal Python surface,
@@ -89,6 +95,9 @@ class _WorkerInfo:
         # last node-memory-pool snapshot from /v1/info (None = worker runs
         # without a governed pool); feeds the cluster memory manager + /ui
         self.mem: Optional[dict] = None
+        # last node-disk-pool snapshot (runtime/disk.py): feeds the spool
+        # pressure-reclaim escalation in the coordinator GC tick
+        self.disk: Optional[dict] = None
 
 
 class Coordinator:
@@ -156,6 +165,11 @@ class Coordinator:
         self._m_heals = self.metrics.counter(
             "trino_tpu_task_heals_total",
             "Dead-producer recoveries (spool re-point or recompute)",
+        )
+        self._m_spool_repro = self.metrics.counter(
+            "trino_tpu_spool_reproductions_total",
+            "Producer tasks re-run because their committed spool partition "
+            "was missing or corrupt at read time (self-healing spool)",
         )
         self._m_breaker = self.metrics.counter(
             "trino_tpu_circuit_breaker_transitions_total",
@@ -589,6 +603,9 @@ class Coordinator:
                     w.mem = info.get("memory_pool")
                     if w.mem:
                         mem_snapshots[w.url] = w.mem
+                    # disk-pool snapshots ride the same heartbeat: the GC
+                    # tick below escalates spool reclaim under pressure
+                    w.disk = info.get("disk_pool")
                 except Exception:
                     w.failures += 1
                     det.record_failure(w.url)
@@ -664,6 +681,27 @@ class Coordinator:
             SpooledExchange(d).gc(
                 live, age_s=float(self.session.get("spool_gc_age_s") or 0.0)
             )
+        except Exception:
+            traceback.print_exc()
+        # pressure escalation (disk governance, runtime/disk.py): when a
+        # node's disk-pool heartbeat shows the spool budget nearly full,
+        # the age-based sweep above is not enough — reclaim NOW, memo
+        # namespaces first, then non-live query dirs, before any commit on
+        # that node has to shed.  The live set passed here is the
+        # coordinator-local ∪ fleet-wide union, so a peer's running query
+        # is never evicted (the fleet-liveness contract).
+        try:
+            for w in list(self.workers.values()):
+                dp = getattr(w, "disk", None)
+                if not dp or not dp.get("capacity"):
+                    continue
+                cap = int(dp["capacity"])
+                used = int(dp.get("reserved") or 0)
+                if used > 0.8 * cap:
+                    SpooledExchange(d).reclaim(
+                        used - int(0.5 * cap), live_query_ids=live
+                    )
+                    return  # one reclaim pass per tick is plenty
         except Exception:
             traceback.print_exc()
 
@@ -1553,6 +1591,102 @@ class Coordinator:
                 moved = True
             return moved
 
+        # self-healing spool (the PR 16 robustness plane): when a consumer
+        # reads a producer partition the log says COMMITTED and finds it
+        # missing or corrupt (disk died, an operator rm -rf'd the spool,
+        # pressure GC raced), the consumer fails with the typed
+        # "SPOOL_LOST:{producer_tid}:" marker — and instead of failing the
+        # query we RE-RUN that producer under the same task id.  The spooled
+        # exchange's first-commit-wins rename arbitrates exactly-once on
+        # disk, so a reproduction is indistinguishable from the original to
+        # every other consumer.  Bounded per query by spool_reproduce_limit.
+        repro_lock = threading.Lock()
+        repro_count = [0]
+
+        def reproduce_lost(lost_tid: str, _depth: int = 0) -> bool:
+            if spool is None or _depth > 4:
+                return False
+            hit = None
+            for fid_r, (pb_r, tag_r) in frag_meta.items():
+                if lost_tid.startswith(tag_r + "_p"):
+                    hit = (fid_r, pb_r, tag_r)
+                    break
+            if hit is None:
+                return False  # not ours (stale attempt namespace)
+            fid_r, payload_base_r, tag_r = hit
+            try:
+                part = int(lost_tid[len(tag_r) + 2:].split("_", 1)[0])
+            except ValueError:
+                return False
+            limit = int(self.session.get("spool_reproduce_limit") or 0)
+            with repro_lock:
+                if repro_count[0] >= limit:
+                    return False
+                repro_count[0] += 1
+                n = repro_count[0]
+            self._m_spool_repro.inc()
+            record["spool_reproductions"] = (
+                record.get("spool_reproductions", 0) + 1
+            )
+            # clear the corrupt/partial partition so the reproduction's
+            # commit rename lands (first-commit-wins would otherwise treat
+            # the damaged dir as the winner)
+            spool.discard(lost_tid)
+            f_r = frag_by_id[fid_r]
+            prev = (task_urls.get(fid_r) or [None] * (part + 1))[part]
+            for k in range(2):
+                alive = self.alive_workers()
+                if prev is not None:  # not back onto the worker that ran it
+                    alive = [w for w in alive if w != prev[0]] or alive
+                if not alive:
+                    return False
+                w = alive[(part + n + k) % len(alive)]
+                payload = dict(
+                    payload_base_r,
+                    sources=self._sources_payload(f_r, frag_by_id, task_urls),
+                    task_id=lost_tid,
+                    part=part,
+                    attempt=f"r{n}",  # distinct spool staging dir
+                )
+                all_tasks.append((w, lost_tid))
+                try:
+                    self._post_task(w, payload)
+                    state = self._wait_task(w, lost_tid)
+                except Exception:
+                    continue
+                if state == "FINISHED":
+                    lst = task_urls.get(fid_r)
+                    if lst is not None and part < len(lst):
+                        # consumers re-read the re-committed partition
+                        # straight from the spool
+                        lst[part] = (SPOOL_URL, lost_tid)
+                    return True
+                # nested loss: the reproduced producer's own spool source
+                # vanished too — heal bottom-up, then retry this one
+                try:
+                    err = str(self._task_info(w, lost_tid).get("error") or "")
+                except Exception:
+                    err = ""
+                mm = _SPOOL_LOST_RE.search(err)
+                if not (mm and reproduce_lost(mm.group(1), _depth + 1)):
+                    return False
+            return False
+
+        def on_task_failed(u: str, tid: str) -> None:
+            # called by _run_stage_phased when every live attempt of a part
+            # ended badly, BEFORE the consumer's retry is posted: if the
+            # failure names a lost producer partition, reproduce it now so
+            # the retry (whose refresh_sources re-reads task_urls) succeeds
+            if spool is None or u == SPOOL_URL:
+                return
+            try:
+                err = str(self._task_info(u, tid).get("error") or "")
+            except Exception:
+                return
+            m = _SPOOL_LOST_RE.search(err)
+            if m:
+                reproduce_lost(m.group(1))
+
         sm.transition("RUNNING")
         # per-stage wall intervals (seconds since query start): EXPLAIN
         # ANALYZE / tests read these to see sibling stages overlapping
@@ -1733,6 +1867,7 @@ class Coordinator:
                     precommitted=pre or None,
                     on_part_done=on_commit if spool is not None else None,
                     split_sched=sched,
+                    on_task_failed=on_task_failed if spool is not None else None,
                 )
             finally:
                 if sched is not None:
@@ -1840,7 +1975,13 @@ class Coordinator:
                             raise RuntimeError(self._failure_detail(all_tasks, e))
                         # producer died between finishing and our fetch:
                         # re-read from the spool (or recompute it and
-                        # anything it lost when nothing committed)
+                        # anything it lost when nothing committed) — and
+                        # when the COMMITTED partition itself is lost or
+                        # corrupt, self-heal by reproducing the producer
+                        if spool is not None and (
+                            u == SPOOL_URL or "spooled chunk removed" in str(e)
+                        ):
+                            reproduce_lost(t)
                         heal(child_id)
                         u, t = task_urls[child_id][i]
                         try:
@@ -2193,6 +2334,7 @@ class Coordinator:
         precommitted: Optional[dict[int, str]] = None,
         on_part_done=None,
         split_sched: Optional[SplitScheduler] = None,
+        on_task_failed=None,
     ) -> list[tuple[str, str]]:
         """Post one stage's tasks, poll statuses, and re-schedule individual
         failures onto other alive workers (task-level recovery).  Every
@@ -2371,6 +2513,15 @@ class Coordinator:
                                     pending[p] = still + [(w, tid)]
                     continue
                 # every live attempt of this part ended badly: task retry
+                if on_task_failed is not None:
+                    # self-healing spool hook: a failure naming a lost
+                    # producer partition reproduces the producer BEFORE
+                    # this part's retry posts (coordinator _run_once)
+                    for a in atts:
+                        try:
+                            on_task_failed(*a)
+                        except Exception:
+                            traceback.print_exc()
                 attempts[p] += 1
                 backup_worker.pop(p, None)
                 if attempts[p] >= max_attempts:

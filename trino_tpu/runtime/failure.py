@@ -338,9 +338,13 @@ class FaultInjector:
         reject the chunk (PAGE_TRANSPORT_ERROR) and re-fetch the same
         token; a silent wrong-rows result is the failure being tested.
 
-    MEMORY_PRESSURE is consumed at arm time by the worker's
-    /v1/inject_failure handler (it shrinks the node memory pool to the
-    request's `capacity_bytes` immediately), not at a hook point here.
+    MEMORY_PRESSURE and DISK_FULL are consumed at arm time by the worker's
+    /v1/inject_failure handler (they shrink the node memory pool / node
+    disk pool to the request's `capacity_bytes` immediately), not at a
+    hook point here.  SPOOL_LOST is consumed by spool_lost() at a
+    consuming worker's source read: the committed partition is deleted
+    before the read, and the coordinator's self-healing path must re-run
+    the producer.
 
     `probability` < 1 arms a probabilistic variant: each match fires with
     that probability using a per-rule seeded rng (deterministic chaos).
@@ -349,6 +353,7 @@ class FaultInjector:
     MODES = (
         "ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP", "CORRUPT",
         "MEMORY_PRESSURE", "COMPILE_SLOW", "COMPILE_FAIL", "SPLIT_LOST",
+        "SPOOL_LOST", "DISK_FULL",
     )
 
     def __init__(self):
@@ -420,6 +425,15 @@ class FaultInjector:
     def drop_fetch(self, task_id: str) -> bool:
         """True == answer this page-fetch request with a transient 503."""
         return self._take(task_id, ("EXCHANGE_DROP",)) is not None
+
+    def spool_lost(self, producer_task_id: str) -> bool:
+        """True == the caller (a consuming worker about to read a spooled
+        source) should DELETE the producer's committed spool partition
+        first — modeling durable-exchange storage loss.  The read then
+        fails typed (SPOOL_LOST), and the coordinator must re-run the
+        producer under first-commit-wins instead of failing the query
+        (the self-healing-spool path this mode exists to exercise)."""
+        return self._take(producer_task_id, ("SPOOL_LOST",)) is not None
 
     def compile_fault(
         self, task_id: str, sleep: Callable[[float], None] = time.sleep

@@ -312,14 +312,26 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 1,
         ),
         _Prop(
-            "split_driven_scans", bool, False,
-            "enumerate row-range scans as fixed-capacity connector splits "
+            "split_driven_scans", bool, True,
+            "enumerate scans as fixed-capacity connector splits "
             "(runtime/splits.py) and schedule them individually: one task "
-            "per morsel, per-split retry/steal under retry_policy=TASK, "
-            "and scan shapes pinned to split_target_rows so jit signatures "
-            "stop depending on data scale (reference: connector split "
-            "sources lazily scheduled onto drivers)",
+            "per morsel (row-range morsels, or file/row-group units for "
+            "file-backed connectors), per-split retry/steal under "
+            "retry_policy=TASK, and scan shapes pinned to split_target_rows "
+            "so jit signatures stop depending on data scale (reference: "
+            "connector split sources lazily scheduled onto drivers).  ON "
+            "by default for retry_policy=TASK phased runs since the sf10 "
+            "storage chaos drill; set false to opt out",
             None,
+        ),
+        _Prop(
+            "spool_reproduce_limit", int, 3,
+            "self-healing spool bound: how many lost/corrupt committed "
+            "spool partitions the coordinator re-runs producers for "
+            "(per query) before the query fails — the re-run publishes "
+            "under first-commit-wins, so consumers re-read a byte-identical "
+            "partition (trino_tpu_spool_reproductions_total counts them)",
+            lambda v: v >= 0,
         ),
         _Prop(
             "split_target_rows", int, 65536,

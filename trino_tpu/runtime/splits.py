@@ -95,6 +95,12 @@ def scan_split_plan(root, catalogs, target_rows: int):
     - any bucketed scan -> None (the distribute pass aligned the fragment's
       partitioning with the connector bucket count; morselizing would break
       collocated-join alignment)
+    - any scanned connector exposing ``scan_unit_plan`` (file-backed
+      storage: connectors/parquet.py) -> FILE-BACKED splits: one task per
+      (file, row-group) unit of the unit-richest table, so an sf10 scan
+      over a partitioned parquet dir streams file-by-file under the same
+      retry/steal/park machinery; the pad covers the fattest unit (and the
+      fattest bucket of every co-scanned table)
     - otherwise the fragment's scans are cut into ``ceil(rows / pad_rows)``
       row-range morsels where ``pad_rows = pow2(target_rows)`` is also the
       fixed capacity every morsel's scan page pads to.  Sizing uses the
@@ -106,7 +112,9 @@ def scan_split_plan(root, catalogs, target_rows: int):
     if not scans:
         return None
     rows = 0.0
+    unit_plans: list[tuple[int, int]] = []  # (n_units, max_unit_rows)
     for s in scans:
+        conn = None
         try:
             conn = catalogs.get(s.catalog)
             if conn.table_partitioning(s.table):
@@ -115,6 +123,27 @@ def scan_split_plan(root, catalogs, target_rows: int):
             pass
         n = scan_rows(s, catalogs)
         rows = max(rows, n if n is not None else estimate(s, catalogs).rows)
+        up = getattr(conn, "scan_unit_plan", None)
+        if up is not None:
+            try:
+                plan = up(s.table)
+            except Exception:
+                plan = None
+            if plan and plan[0] > 0:
+                unit_plans.append(plan)
+    if unit_plans:
+        # file-backed: the stage fans out to one task per storage unit of
+        # the unit-richest scan; get_splits(table, nsplits) then deals one
+        # unit per bucket.  Every scan in the fragment is sliced by the
+        # same (part, nsplits), so the fixed morsel capacity must cover
+        # the fattest bucket across ALL scans: row-range co-scans get
+        # ceil(rows / nsplits) rows, file-backed co-scans get up to
+        # ceil(n_units / nsplits) whole units.
+        nsplits = max(n_u for n_u, _ in unit_plans)
+        need = max(1, math.ceil(rows / nsplits))
+        for n_u, max_r in unit_plans:
+            need = max(need, math.ceil(n_u / nsplits) * max_r)
+        return nsplits, _pow2(need)
     pad = _pow2(max(1, int(target_rows)))
     nsplits = max(1, math.ceil(rows / pad))
     return nsplits, pad

@@ -36,7 +36,8 @@ Reference wiring this replaces (SURVEY §2.8, §3.2-3.3):
                               update that triggers spillable operators)
   POST /v1/inject_failure     test-only fault matrix (ERROR | TIMEOUT |
                               SLOW | EXCHANGE_DROP | CORRUPT |
-                              MEMORY_PRESSURE, counted/probabilistic;
+                              MEMORY_PRESSURE | DISK_FULL | SPOOL_LOST,
+                              counted/probabilistic;
                               execution/FailureInjector.java:33 — see
                               runtime/failure.py FaultInjector)
 
@@ -65,6 +66,7 @@ from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
 from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env
+from .disk import DiskExceeded, NodeDiskPool, guarded_write
 from .failure import Backoff, FaultInjector
 from .memory import NodeMemoryPool
 from .spool import SPOOL_URL, SpooledExchange
@@ -188,6 +190,8 @@ class Worker:
         task_concurrency: int = 4,
         buffer_memory_bytes: Optional[int] = None,
         node_memory_bytes: Optional[int] = None,
+        disk_budget_bytes: Optional[int] = None,
+        disk_blocked_timeout_s: float = 10.0,
     ):
         self.catalogs = catalogs
         self.default_catalog = default_catalog
@@ -199,6 +203,13 @@ class Worker:
         self.memory_pool: Optional[NodeMemoryPool] = (
             NodeMemoryPool(node_memory_bytes) if node_memory_bytes else None
         )
+        # node disk pool (runtime/disk.py, symmetric to the memory plane):
+        # spool commits and spill files lease bytes against the
+        # `spool.disk-budget-bytes` budget; None = ungoverned
+        self.disk_pool: Optional[NodeDiskPool] = (
+            NodeDiskPool(disk_budget_bytes) if disk_budget_bytes else None
+        )
+        self.disk_blocked_timeout_s = disk_blocked_timeout_s
         # output-buffer memory bound (reference: OutputBufferMemoryManager):
         # finished chunks past this byte budget spill to a local directory
         # and are served back by file read.  The dir is created eagerly (a
@@ -303,6 +314,8 @@ class Worker:
         self.url = f"http://127.0.0.1:{self.port}"
         if self.memory_pool is not None:
             self.memory_pool.name = f"worker:{self.port}"
+        if self.disk_pool is not None:
+            self.disk_pool.name = f"worker:{self.port}"
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     def buffered_bytes(self) -> int:
@@ -350,8 +363,21 @@ class Worker:
                         path = os.path.join(
                             self._spill_dir, f"{task.task_id}_b{p}_t{i}.bin"
                         )
-                        with open(path, "wb") as f:
-                            f.write(blob)
+                        # governed spill: lease the bytes (block -> reclaim
+                        # -> typed shed) and write through the ENOSPC guard.
+                        # The lease's path makes it self-releasing: the ack
+                        # / delete_task unlink is harvested by the pool's
+                        # refresh pass at the next pressure event.
+                        if self.disk_pool is not None:
+                            self.disk_pool.reserve(
+                                task.task_id,
+                                len(blob),
+                                timeout_s=self.disk_blocked_timeout_s,
+                                what=f"buffer spill {task.task_id}",
+                                path=path,
+                                abort=lambda: task.canceled,
+                            )
+                        guarded_write(path, blob)
                         self.spilled_chunks += 1
                         entries.append(path)
                 out[p] = entries
@@ -699,9 +725,44 @@ class Worker:
                         # producer is gone; its committed output lives in
                         # the durable exchange (re-read, not recompute)
                         spool = SpooledExchange(req["exchange_dir"])
-                        blobs.extend(spool.read_chunks(t, buffer_id))
+                        if self.fault_injector.spool_lost(t):
+                            # SPOOL_LOST chaos: the committed partition
+                            # vanishes right before we read it — the typed
+                            # failure below must drive a reproduction, not
+                            # a query failure
+                            spool.discard(t)
+                        try:
+                            blobs.extend(spool.read_chunks(t, buffer_id))
+                        except (FileNotFoundError, PageTransportError) as e:
+                            # typed self-healing signal: the coordinator
+                            # parses the producer task id out of this
+                            # marker, re-runs the producer under
+                            # first-commit-wins, then retries this task
+                            raise RuntimeError(
+                                f"SPOOL_LOST:{t}: committed spool "
+                                f"partition missing or corrupt: {e}"
+                            ) from e
                     else:
-                        blobs.extend(_stream_fetch(u, t, buffer_id, ack=ack))
+                        if req.get("exchange_dir") and (
+                            self.fault_injector.spool_lost(t)
+                        ):
+                            # SPOOL_LOST chaos, HTTP flavor: the producer's
+                            # committed partition vanishes from the shared
+                            # exchange dir — its worker will 410 the fetch
+                            SpooledExchange(req["exchange_dir"]).discard(t)
+                        try:
+                            blobs.extend(
+                                _stream_fetch(u, t, buffer_id, ack=ack)
+                            )
+                        except RuntimeError as e:
+                            if "spooled chunk removed" in str(e):
+                                # the serving worker's backing file is gone
+                                # (HTTP 410): same healing path as a direct
+                                # spool read failure
+                                raise RuntimeError(
+                                    f"SPOOL_LOST:{t}: {e}"
+                                ) from e
+                            raise
             from ..data.types import parse_type
 
             fetched_bytes += sum(len(b) for b in blobs)
@@ -822,8 +883,11 @@ class Worker:
         if exchange_dir:
             # durable spooled exchange: commit to storage FIRST, then
             # serve every chunk from the spool files — worker RAM holds
-            # no finished output (bounded memory + dead-producer re-read)
-            spool = SpooledExchange(exchange_dir)
+            # no finished output (bounded memory + dead-producer re-read).
+            # The node disk pool governs the commit: lease -> reclaim ->
+            # block -> typed EXCEEDED_SPILL_LIMIT, never a raw ENOSPC.
+            spool = SpooledExchange(exchange_dir, disk_pool=self.disk_pool)
+            spool.disk_blocked_timeout_s = self.disk_blocked_timeout_s
             # per-attempt staging dir (speculation runs two live attempts
             # of the same task id); the spool's rename publish arbitrates
             # first-commit-wins — the loser's bytes are discarded and
@@ -991,6 +1055,10 @@ class Worker:
             self._m_pool_capacity.set(snap["capacity"])
             self._m_pool_reserved.set(snap["reserved"])
             self._m_pool_blocked.set(snap["blocked"])
+        if self.disk_pool is not None:
+            # snapshot() refreshes the GLOBAL trino_tpu_disk_pool_* gauges
+            # (labeled by this pool's name) rendered via `extra` below
+            self.disk_pool.snapshot()
         return self.metrics.render(extra=_metrics.GLOBAL)
 
     def revoke_query_memory(self, query_id: str) -> int:
@@ -1183,6 +1251,14 @@ def _make_handler(worker: Worker):
                             if worker.memory_pool is not None
                             else None
                         ),
+                        # disk-pool reservations ride the heartbeat too —
+                        # the coordinator's pressure-based spool reclaim
+                        # keys off these (runtime/disk.py)
+                        "disk_pool": (
+                            worker.disk_pool.snapshot()
+                            if worker.disk_pool is not None
+                            else None
+                        ),
                     }
                 ).encode()
                 return self._send(200, body, "application/json")
@@ -1264,6 +1340,20 @@ def _make_handler(worker: Worker):
                 )
             if parts[:2] == ["v1", "inject_failure"]:
                 req = json.loads(body)
+                if str(req.get("mode", "")).upper() == "DISK_FULL":
+                    # consumed at arm time (like MEMORY_PRESSURE): shrink
+                    # the node disk pool NOW — new spool/spill writes see
+                    # reclaim -> block -> typed EXCEEDED_SPILL_LIMIT, and
+                    # task retry moves the attempt to a node with disk left
+                    if worker.disk_pool is None:
+                        return self._send(400, b"worker has no disk pool")
+                    worker.disk_pool.set_capacity(
+                        int(req.get("capacity_bytes") or 0)
+                    )
+                    worker.fault_injector.record_fired(
+                        "DISK_FULL", req.get("task_id", "*")
+                    )
+                    return self._send(200, b"{}", "application/json")
                 if str(req.get("mode", "")).upper() == "MEMORY_PRESSURE":
                     # consumed at arm time: shrink the node pool NOW; the
                     # deficit shows as reserved > capacity on the next

@@ -93,6 +93,8 @@ def main(argv=None) -> int:
         catalogs, default_catalog, port=cfg.port,
         task_concurrency=cfg.task_concurrency,
         node_memory_bytes=cfg.node_memory_bytes,
+        disk_budget_bytes=cfg.disk_budget_bytes or None,
+        disk_blocked_timeout_s=cfg.disk_blocked_timeout_s,
     ).start()
     print(f"worker listening on {worker.url}", flush=True)
     # fleet-aware discovery: announce to EVERY coordinator in

@@ -21,7 +21,7 @@ from .runner import DistributedQueryRunner
 
 __all__ = [
     "ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES", "COMPILE_MODES",
-    "SPLIT_MODES",
+    "SPLIT_MODES", "STORAGE_MODES",
 ]
 
 # modes that a retry_policy=TASK cluster must absorb without losing the
@@ -45,6 +45,18 @@ CORRUPTION_MODES = RECOVERABLE_MODES + ("CORRUPT",)
 # replay identically; pass modes=COMPILE_MODES (or RECOVERABLE_MODES +
 # COMPILE_MODES) to arm it.
 COMPILE_MODES = ("COMPILE_SLOW", "COMPILE_FAIL")
+
+# opt-in: storage-plane chaos (runtime/disk.py + the self-healing spool).
+# SPOOL_LOST deletes a producer's COMMITTED spool partition right before a
+# consumer reads it — the consumer fails typed ("SPOOL_LOST:{tid}:") and
+# the coordinator must REPRODUCE the producer under first-commit-wins
+# instead of failing the query.  DISK_FULL shrinks a worker's NodeDiskPool
+# at arm time (capacity_bytes) — commits on that node reclaim, block, then
+# shed with the typed EXCEEDED_SPILL_LIMIT error that task retry rotates
+# away from.  A separate tuple — not folded into RECOVERABLE_MODES — so
+# existing seeded schedules replay identically; pass
+# modes=RECOVERABLE_MODES + STORAGE_MODES to arm it alongside the rest.
+STORAGE_MODES = ("SPOOL_LOST", "DISK_FULL")
 
 # opt-in: split-plane chaos (runtime/splits.py).  SPLIT_LOST raises inside
 # one task's execution hook — under split_driven_scans a task IS one
@@ -98,6 +110,14 @@ class ChaosRunner:
                 ),
                 "count": self.rng.randint(1, 3) if mode == "EXCHANGE_DROP" else 1,
             }
+            if mode == "DISK_FULL":
+                # consumed at arm time: shrink the worker's NodeDiskPool so
+                # commits/spills there reclaim -> block -> shed typed (the
+                # cluster must only be armed with this mode when its
+                # workers run a governed disk pool)
+                ev["capacity_bytes"] = self.rng.choice(
+                    (64 << 10, 256 << 10, 1 << 20)
+                )
             self.runner.inject_task_failure(**ev)
             events.append(ev)
         self.schedule.append(events)
@@ -214,16 +234,20 @@ def make_chaos_cluster(
     modes: Sequence[str] = RECOVERABLE_MODES,
     num_coordinators: int = 1,
     fleet_ttl_s: float = 10.0,
+    disk_budget_bytes: Optional[int] = None,
 ) -> tuple[DistributedQueryRunner, ChaosRunner]:
     """Start a retry_policy=TASK cluster plus its ChaosRunner.  The caller
     owns shutdown (runner.stop()).  num_coordinators>1 stands up a
-    coordinator fleet behind a FleetRouter for failover chaos."""
+    coordinator fleet behind a FleetRouter for failover chaos.
+    disk_budget_bytes gives every worker a governed NodeDiskPool —
+    required when arming STORAGE_MODES (DISK_FULL shrinks that pool)."""
     runner = DistributedQueryRunner(
         num_workers=num_workers,
         default_catalog=default_catalog,
         heartbeat_interval=heartbeat_interval,
         num_coordinators=num_coordinators,
         fleet_ttl_s=fleet_ttl_s,
+        disk_budget_bytes=disk_budget_bytes,
     )
     runner.register_catalog(default_catalog, catalog_factory())
     runner.start()

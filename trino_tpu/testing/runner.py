@@ -32,6 +32,7 @@ class DistributedQueryRunner:
         worker_buffer_memory_bytes: Optional[int] = None,
         cluster_memory_limit_bytes: int = 0,
         node_memory_bytes: Optional[int] = None,
+        disk_budget_bytes: Optional[int] = None,
         journal_path: Optional[str] = None,
         num_coordinators: int = 1,
         fleet_dir: Optional[str] = None,
@@ -44,6 +45,7 @@ class DistributedQueryRunner:
         self.worker_buffer_memory_bytes = worker_buffer_memory_bytes
         self.cluster_memory_limit_bytes = cluster_memory_limit_bytes
         self.node_memory_bytes = node_memory_bytes
+        self.disk_budget_bytes = disk_budget_bytes
         self.journal_path = journal_path
         # coordinator fleet (runtime/fleet.py): N>1 members share a lease
         # dir (auto-created when not given) behind a FleetRouter front door
@@ -114,6 +116,7 @@ class DistributedQueryRunner:
                 self.default_catalog,
                 buffer_memory_bytes=self.worker_buffer_memory_bytes,
                 node_memory_bytes=self.node_memory_bytes,
+                disk_budget_bytes=self.disk_budget_bytes,
             ).start()
             self.workers.append(w)
             # the worker knows every coordinator so a completed drain can
@@ -253,6 +256,15 @@ class DistributedQueryRunner:
         calls see the reduced capacity and park BLOCKED."""
         self.inject_task_failure(
             worker_index, mode="MEMORY_PRESSURE", capacity_bytes=capacity_bytes
+        )
+
+    def disk_full(self, worker_index: int, capacity_bytes: int) -> None:
+        """Shrink one worker's NodeDiskPool mid-run — the DISK_FULL chaos
+        lever.  Spool commits and spill writes on that node reclaim, then
+        block, then shed with the typed EXCEEDED_SPILL_LIMIT error that
+        the coordinator's task retry rotates away from."""
+        self.inject_task_failure(
+            worker_index, mode="DISK_FULL", capacity_bytes=capacity_bytes
         )
 
     def __enter__(self):
