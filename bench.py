@@ -457,6 +457,69 @@ def _bench_fleet(deadline) -> dict:
     return out
 
 
+def _bench_observability(deadline) -> dict:
+    """Flight-recorder overhead harness (ISSUE 17): warm p50 for q01/q06 on
+    a local Engine with the recorder enabled vs disabled.  The recorder is a
+    process-global bounded ring behind one lock; the acceptance budget is
+    <5% warm-p50 overhead, reported per query as regression_pct +
+    within_budget so perf CI can check it without a prior-run baseline."""
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.runtime.engine import Engine
+    from trino_tpu.utils import flightrecorder as fr
+
+    sf = float(os.environ.get("BENCH_OBS_SF", "0.1"))
+    iters = int(os.environ.get("BENCH_OBS_ITERS", "9"))
+    eng = Engine()
+    eng.register_catalog("tpch", TpchConnector(sf))
+    out = {"sf": sf, "iters": iters, "budget_pct": 5.0, "queries": {}}
+
+    def paired_p50(plan) -> tuple:
+        # interleave one off-run and one on-run per iteration so host drift
+        # (thermal, allocator state, noisy neighbours) lands on both sides
+        # instead of biasing whichever pass ran second
+        offs: list = []
+        ons: list = []
+        for _ in range(iters):
+            fr.configure(enabled=False)
+            t0 = time.perf_counter()
+            eng.executor.execute(plan)
+            offs.append(time.perf_counter() - t0)
+            fr.configure(enabled=True)
+            t0 = time.perf_counter()
+            eng.executor.execute(plan)
+            ons.append(time.perf_counter() - t0)
+            if deadline.remaining() < 5:
+                break
+        return (sorted(offs)[len(offs) // 2], sorted(ons)[len(ons) // 2])
+
+    prior = fr.stats()["enabled"]
+    try:
+        for name in ("q01", "q06"):
+            if deadline.remaining() < 30:
+                out["queries"][name] = {"skipped": "deadline"}
+                continue
+            plan = eng.plan(QUERIES[name])
+            eng.executor.execute(plan)  # cold: generation + upload + compile
+            eng.executor.execute(plan)  # adaptive-compaction recompile
+            eng.executor.execute(plan)  # settle before the timed pairs
+            off, on = paired_p50(plan)
+            pct = 100.0 * (on - off) / off if off > 0 else 0.0
+            out["queries"][name] = {
+                "warm_p50_off_s": round(off, 4),
+                "warm_p50_on_s": round(on, 4),
+                "regression_pct": round(pct, 2),
+                "within_budget": pct < 5.0,
+            }
+    finally:
+        fr.configure(enabled=prior)
+    out["within_budget"] = all(
+        q.get("within_budget", True)
+        for q in out["queries"].values()
+        if isinstance(q, dict)
+    )
+    return out
+
+
 def _bench_prepared(deadline) -> dict:
     """Serving fast path (runtime/fastpath.py): PREPARE once, EXECUTE with a
     different parameter every time, against the same workload issued the old
@@ -935,6 +998,14 @@ def main() -> None:
             result["multi_scale"] = _bench_multi_scale(deadline)
         except Exception as e:
             result["multi_scale"] = {"error": str(e)[:200]}
+        emit()
+
+    # ---- flight-recorder overhead: warm p50 on vs off (ISSUE 17) ---------
+    if os.environ.get("BENCH_OBSERVABILITY", "1") != "0" and deadline.remaining() > 60:
+        try:
+            result["observability"] = _bench_observability(deadline)
+        except Exception as e:
+            result["observability"] = {"error": str(e)[:200]}
         emit()
 
     # ---- serving fast path: PREPARE/EXECUTE vs ad-hoc text (ISSUE 10) ----

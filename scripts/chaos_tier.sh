@@ -39,6 +39,11 @@
 #           accounting, ENOSPC conversion, reclaim escalation order.
 #           CI runs at sf0.1-equivalent row counts; set CHAOS_SF to crank
 #           the at-scale drill (sf10 is the acceptance bar on big hosts)
+# Post-mortem chaos (tests/test_flightrecorder.py):
+#   postmortem   worker kill mid-query -> cross-node flight-recorder
+#                bundle renders one correlated timeline (kill + retry +
+#                every surviving node's lane); anomaly-sentinel slow-run
+#                drill; bundle survival across a coordinator restart
 # Coordinator-fleet chaos (tests/test_fleet.py):
 #   fleet   kill one coordinator of a two-member fleet mid multi-stage
 #           query — a peer adopts it off the dead member's journal
@@ -97,6 +102,16 @@ case "${1:-}" in
   fleet)
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+        -p no:cacheprovider "$@"
+    ;;
+  postmortem)
+    shift
+    # flight-recorder / post-mortem chaos (tests/test_flightrecorder.py):
+    # kill a worker mid-query under TASK retry — the query succeeds AND
+    # the cross-node bundle renders one correlated timeline with the kill,
+    # the retry dispatch, and events from every surviving node; plus the
+    # sentinel slow-run drill and the bundle-survives-restart drill
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_flightrecorder.py -q \
         -p no:cacheprovider "$@"
     ;;
   cache)

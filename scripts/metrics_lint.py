@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Lint the /metrics exposition against itself and the README.
 
-Two failure classes, both exit 2:
+Three failure classes, all exit 2:
 
 1. An exposed metric family is missing `# HELP` text (every instrument
    in utils/metrics.py takes a help string — an empty one means somebody
@@ -9,6 +9,9 @@ Two failure classes, both exit 2:
 2. A `trino_tpu_*` metric documented in the README does not appear in
    any scraped exposition — documentation drift, usually a renamed or
    deleted instrument.
+3. A scraped `trino_tpu_*` family does not appear in the README — the
+   other drift direction: somebody shipped an instrument without
+   documenting it for operators.
 
 README names are extracted from backtick spans; brace shorthand like
 ``trino_tpu_exchange_{fetched,served}_bytes_total`` expands to every
@@ -102,10 +105,18 @@ def lint(targets: list[str], readme: str) -> list[str]:
             if not helps.get(fam):
                 failures.append(f"{target}: {fam} has no HELP text")
     if all_families:  # README drift only checkable with a live scrape
-        for name in sorted(readme_metrics(readme)):
+        documented = readme_metrics(readme)
+        for name in sorted(documented):
             if name not in all_families:
                 failures.append(
                     f"README documents {name} but no scraped target exposes it"
+                )
+        # reverse direction: every exposed trino_tpu_* family must be
+        # documented — undocumented telemetry is invisible telemetry
+        for fam in sorted(all_families):
+            if fam.startswith("trino_tpu_") and fam not in documented:
+                failures.append(
+                    f"{fam} is exposed but the README does not document it"
                 )
     return failures
 

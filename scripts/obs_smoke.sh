@@ -9,7 +9,11 @@
 # task spans, and the storage-governance plane — trino_tpu_disk_pool_*
 # gauges on governed workers and a nonzero
 # trino_tpu_spool_reproductions_total after SPOOL_LOST injection (the
-# self-healing spool actually healing).
+# self-healing spool actually healing), plus the post-mortem plane —
+# nonzero trino_tpu_flightrecorder_events_total, GET /v1/flightrecorder
+# on both node roles, a seeded SLOW re-run carrying the `-- anomaly:`
+# EXPLAIN ANALYZE footer, and the auto + on-demand post-mortem bundle
+# round-trip over GET/POST /v1/query/{id}/postmortem.
 #
 # Fast enough to run on every runtime/ or exec/ change; the same checks
 # run under the tier-1 gate via tests/test_obs_plane.py.
@@ -229,6 +233,66 @@ try:
         f"expected a nonzero spool-reproduction counter: {repro}"
     )
     print(f"spool reproductions counter: {repro[0].split()[-1]}")
+
+    # flight-recorder plane (utils/flightrecorder.py): the event counter
+    # must have moved, and both node roles must serve their ring slice
+    mtext4 = get(base + "/metrics")
+    frlines = [
+        ln for ln in mtext4.splitlines()
+        if ln.startswith("trino_tpu_flightrecorder_events_total{")
+    ]
+    assert frlines and sum(float(ln.split()[-1]) for ln in frlines) > 0, (
+        f"expected nonzero flight-recorder event counters: {frlines[:3]}"
+    )
+    print(f"flightrecorder: {len(frlines)} event kinds counted")
+    with coord._lock:
+        fr_qid = list(coord.queries)[-1]
+    fr = json.loads(get(f"{base}/v1/flightrecorder?query_id={fr_qid}"))
+    assert fr["events"], "coordinator flight-recorder slice is empty"
+    wfr = json.loads(get(f"{runner.workers[0].url}/v1/flightrecorder"
+                         f"?query_id={fr_qid}"))
+    assert all(e["node"] in (runner.workers[0].url,
+                             f"worker:{runner.workers[0].port}")
+               for e in wfr["events"]), "worker served another node's lane"
+    print(f"GET /v1/flightrecorder: coord {len(fr['events'])} events, "
+          f"worker {len(wfr['events'])} events ok")
+
+    # anomaly sentinel + post-mortem: one clean baseline run, then a
+    # seeded SLOW re-run must carry the `-- anomaly:` EXPLAIN ANALYZE
+    # footer and auto-write a bundle; the on-demand POST must round-trip
+    coord.session.set("result_cache_enabled", "false")
+    coord.session.set("anomaly_min_samples", "1")
+    ANOM_SQL = ("explain analyze select l_shipmode, count(*) c "
+                "from lineitem group by l_shipmode order by l_shipmode")
+    runner.query(ANOM_SQL)  # clean run -> baseline sample
+    for i in range(len(runner.workers)):
+        runner.inject_task_failure(i, task_id="*", mode="SLOW",
+                                   delay_ms=2500, count=10)
+    arows = runner.query(ANOM_SQL)
+    for w in runner.workers:
+        w.fault_injector.clear()
+    atext = "\n".join(r[0] for r in arows)
+    alines = [ln for ln in atext.splitlines() if ln.startswith("-- anomaly:")]
+    assert any("SLOW_VS_BASELINE" in ln for ln in alines), (
+        f"expected a SLOW_VS_BASELINE anomaly footer:\n{atext[-600:]}"
+    )
+    print(f"anomaly: {alines[0]}")
+    with coord._lock:
+        anom_qid = list(coord.queries)[-1]
+    bundle = get(f"{base}/v1/query/{anom_qid}/postmortem")
+    header = json.loads(bundle.splitlines()[0])
+    assert header["type"] == "header" and header["query_id"] == anom_qid
+    assert header["anomalies"], "auto-bundle missing the anomaly"
+    req = urllib.request.Request(
+        f"{base}/v1/query/{anom_qid}/postmortem", data=b"{}")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        pm = json.loads(resp.read())
+    assert pm["trigger"] == "on_demand" and pm["events"] > 0
+    amtext = get(base + "/metrics")
+    assert 'trino_tpu_query_anomalies_total{kind="SLOW_VS_BASELINE"}' in amtext
+    assert 'trino_tpu_postmortem_bundles_total{trigger="anomaly"}' in amtext
+    print(f"postmortem: bundle {pm['events']} events from "
+          f"{len(pm['nodes'])} nodes ok")
 finally:
     runner.stop()
 

@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""Render a cross-node post-mortem bundle as one correlated timeline.
+
+The coordinator writes a bundle (one JSONL file: header, QueryInfo,
+journal records, then every node's flight-recorder slice) under the
+spool dir on typed query failure, on sentinel-flagged anomalies, and on
+demand via POST /v1/query/{id}/postmortem.  This script merges the
+per-node lanes into a single wall-clock-ordered timeline with a lane
+column per node and the failure/anomaly events highlighted — the
+"what actually happened, across every machine, in order" view.
+
+Usage:
+    python scripts/postmortem_report.py PATH_OR_URL [--kinds k1,k2] [--limit N]
+
+PATH_OR_URL is either the bundle file on disk
+(<spool>/postmortem_<qid>/bundle.jsonl) or the coordinator's
+GET /v1/query/{id}/postmortem URL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+# event kinds that mark something going wrong — highlighted in the lane
+FAILURE_KINDS = {
+    "task_fail", "task_failed", "worker_dead", "compile_error",
+    "disk_shed", "memory_revoke", "anomaly", "spool_reproduce",
+}
+
+
+def load_bundle(src: str) -> list[dict]:
+    if src.startswith("http://") or src.startswith("https://"):
+        with urllib.request.urlopen(src, timeout=10) as r:
+            blob = r.read().decode("utf-8", errors="replace")
+    else:
+        with open(src, encoding="utf-8") as f:
+            blob = f.read()
+    recs = []
+    for line in blob.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return recs
+
+
+def _fmt_detail(ev: dict) -> str:
+    drop = {"type", "seq", "kind", "node", "ts", "mono"}
+    parts = []
+    for k in ("query_id", "task_id", "trace_id"):
+        v = ev.get(k)
+        if v:
+            parts.append(f"{k.split('_')[0]}={v}")
+    for k, v in sorted((ev.get("detail") or {}).items()):
+        if v is not None:
+            parts.append(f"{k}={v}")
+    for k, v in sorted(ev.items()):
+        if k not in drop and k not in ("query_id", "task_id", "trace_id",
+                                       "detail") and v is not None:
+            parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def render(recs: list[dict], kinds=None, limit: int = 0) -> str:
+    header = next((r for r in recs if r.get("type") == "header"), {})
+    qinfo = next((r for r in recs if r.get("type") == "query_info"), {})
+    journal = [r for r in recs if r.get("type") == "journal"]
+    events = [r for r in recs if r.get("type") == "event"]
+    if kinds:
+        events = [e for e in events if e.get("kind") in kinds]
+
+    out: list[str] = []
+    qid = header.get("query_id", "?")
+    out.append(f"POST-MORTEM  {qid}")
+    out.append(
+        f"  trigger: {header.get('trigger')}   state: {header.get('state')}"
+        f"   events: {header.get('events')}"
+        + (f" (+{header['events_dropped']} dropped over budget)"
+           if header.get("events_dropped") else "")
+    )
+    if header.get("error"):
+        out.append(f"  error: {header['error']}")
+    for a in header.get("anomalies") or []:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(a.items()) if k != "kind"
+        )
+        out.append(f"  anomaly: {a.get('kind')}" + (f" ({detail})" if detail else ""))
+    if header.get("sql"):
+        out.append(f"  sql: {header['sql'][:160]}")
+    ledger = qinfo.get("phase_ledger") or {}
+    if ledger:
+        out.append(
+            "  phases: "
+            + ", ".join(
+                f"{k[:-3]} {v:.0f}ms" for k, v in ledger.items()
+                if isinstance(v, (int, float)) and k.endswith("_ms") and v
+            )
+        )
+
+    # lane assignment: every node that emitted an event gets a column
+    lanes: list[str] = []
+    for ev in events:
+        n = ev.get("node") or "?"
+        if n not in lanes:
+            lanes.append(n)
+    out.append("")
+    out.append(f"NODE LANES ({len(lanes)})")
+    for i, n in enumerate(lanes):
+        count = sum(1 for e in events if (e.get("node") or "?") == n)
+        dead = " [unreachable at bundle time]" if n in (
+            header.get("unreachable_nodes") or []
+        ) else ""
+        out.append(f"  lane {i}: {n}  ({count} events){dead}")
+    for n in header.get("unreachable_nodes") or []:
+        if n not in lanes:
+            out.append(f"  (no lane): {n}  [unreachable, slice missing]")
+
+    # merged timeline: wall-clock order across processes (seq breaks ties
+    # inside one process's ring)
+    events.sort(key=lambda e: (e.get("ts") or 0.0, e.get("seq") or 0))
+    t0 = events[0].get("ts") if events else 0.0
+    if limit and len(events) > limit:
+        out.append(f"  ... showing last {limit} of {len(events)} events")
+        events = events[-limit:]
+    out.append("")
+    out.append("TIMELINE")
+    width = max((len(k) for k in (e.get("kind", "") for e in events)), default=10)
+    for ev in events:
+        lane_i = lanes.index(ev.get("node") or "?")
+        glyphs = "".join(
+            ("●" if i == lane_i else "│") for i in range(len(lanes))
+        )
+        mark = "!" if ev.get("kind") in FAILURE_KINDS else " "
+        dt = (ev.get("ts") or t0) - t0
+        out.append(
+            f"{mark} t+{dt:8.3f}s {glyphs} {ev.get('kind', '?'):<{width}}"
+            f"  {_fmt_detail(ev)}"
+        )
+    if journal:
+        out.append("")
+        out.append(f"JOURNAL ({len(journal)} records)")
+        for j in journal:
+            extras = {
+                k: v for k, v in j.items()
+                if k not in ("type", "kind", "query_id", "ts", "session")
+                and v is not None
+            }
+            out.append(
+                f"  {j.get('kind', '?'):<10} "
+                + " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+            )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="postmortem_report")
+    ap.add_argument("bundle", help="bundle.jsonl path or coordinator URL")
+    ap.add_argument("--kinds", default="", help="comma-separated kind filter")
+    ap.add_argument(
+        "--limit", type=int, default=0, help="show only the last N events"
+    )
+    args = ap.parse_args(argv)
+    recs = load_bundle(args.bundle)
+    if not recs:
+        print(f"no records in {args.bundle}", file=sys.stderr)
+        return 1
+    kinds = {k.strip() for k in args.kinds.split(",") if k.strip()} or None
+    print(render(recs, kinds=kinds, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

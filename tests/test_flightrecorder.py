@@ -1,0 +1,471 @@
+"""Flight recorder, cross-node post-mortem bundles, anomaly sentinel.
+
+Fast unit tests (tier-1): ring semantics, overflow drop accounting,
+snapshot filters, baseline math and cold-start silence, bundle render,
+metrics_lint drift directions.
+
+Cluster drills (marked slow; `scripts/chaos_tier.sh postmortem`): the
+worker-kill chaos drill producing a correlated multi-node bundle, the
+seeded slow-query sentinel drill, bundle survival across a coordinator
+restart, and the 2-thread QueryInfo race regression.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+# --------------------------------------------------------------- ring unit
+
+
+def test_ring_overflow_drop_accounting():
+    from trino_tpu.utils.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder(ring_size=32)
+    for i in range(100):
+        fr.record("tick", node="n1", query_id=f"q{i}")
+    st = fr.stats()
+    assert st["events"] == 100
+    assert st["held"] == 32
+    assert st["dropped"] == 68  # every overwrite counted, never silent
+    snap = fr.snapshot()
+    assert len(snap) == 32
+    # the ring keeps the NEWEST events, in seq order
+    assert [e["seq"] for e in snap] == list(range(69, 101))
+
+
+def test_ring_disabled_records_nothing():
+    from trino_tpu.utils.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder(ring_size=32, enabled=False)
+    fr.record("tick", node="n1")
+    assert fr.stats()["events"] == 0 and fr.snapshot() == []
+    fr.configure(enabled=True)
+    fr.record("tick", node="n1")
+    assert fr.stats()["events"] == 1
+
+
+def test_snapshot_filters_query_task_kind_node():
+    from trino_tpu.utils.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder(ring_size=64)
+    fr.record("task_start", node="w1", task_id="q_aa_f1_p0_t0")
+    fr.record("task_start", node="w2", query_id="q_bb")
+    fr.record("compile_done", node="compilesvc", task_id="q_aa_f1_p0_t0")
+    fr.record("task_finish", node="w1", query_id="q_aa")
+    # query filter matches the event's own query id OR the task-id prefix
+    qa = fr.snapshot(query_id="q_aa")
+    assert [e["kind"] for e in qa] == ["task_start", "compile_done", "task_finish"]
+    assert fr.snapshot(query_id="q_aa", kinds=("task_finish",))[0]["node"] == "w1"
+    assert {e["node"] for e in fr.snapshot(nodes=("w1",))} == {"w1"}
+    assert len(fr.snapshot(query_id="q_aa", limit=1)) == 1
+
+
+def test_ring_thread_safety_under_contention():
+    from trino_tpu.utils.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder(ring_size=128)
+
+    def hammer(n):
+        for i in range(500):
+            fr.record("tick", node=f"n{n}", query_id=f"q{i}")
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = fr.stats()
+    assert st["events"] == 2000
+    assert st["held"] == 128
+    assert st["dropped"] == 2000 - 128
+    seqs = [e["seq"] for e in fr.snapshot()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+# ------------------------------------------------------------ baseline unit
+
+
+def _mk_store():
+    from trino_tpu.runtime.history import QueryHistoryStore
+
+    return QueryHistoryStore(capacity=50)
+
+
+def _clean_run(qid, wall_ms, **kw):
+    rec = {
+        "query_id": qid, "state": "FINISHED", "planhash": "ph1",
+        "wall_ms": wall_ms, "spill_ms": 0.0, "task_retries": 0,
+        "compile_count": 2, "peak_memory_bytes": 1 << 20, "rows": 10,
+        "anomalies": [],
+    }
+    rec.update(kw)
+    return rec
+
+
+def test_baseline_cold_start_stays_silent():
+    store = _mk_store()
+    store.record(_clean_run("q1", 100.0))
+    store.record(_clean_run("q2", 110.0))
+    # below min_samples: no baseline, so the sentinel cannot false-flag
+    assert store.baseline("ph1", min_samples=3) is None
+    assert store.baseline("", min_samples=1) is None
+
+
+def test_baseline_math_and_sample_hygiene():
+    store = _mk_store()
+    for i, w in enumerate((100.0, 120.0, 140.0)):
+        store.record(_clean_run(f"q{i}", w))
+    # excluded: cached runs, FAILED runs, and runs already flagged —
+    # one slow outlier must not drag the baseline up
+    store.record(_clean_run("qc", 9000.0, cached=True))
+    store.record(_clean_run("qf", 9000.0, state="FAILED"))
+    store.record(
+        _clean_run("qa", 9000.0, anomalies=[{"kind": "SLOW_VS_BASELINE"}])
+    )
+    base = store.baseline("ph1", min_samples=3)
+    assert base["samples"] == 3
+    assert base["wall_ms_p50"] == 120.0
+    assert base["wall_ms_p95"] == 140.0
+    assert base["retries_p50"] == 0
+    assert base["compiles_p50"] == 2
+
+
+# ------------------------------------------------------- report render unit
+
+
+def test_postmortem_report_renders_lanes_and_highlights(tmp_path):
+    sys.path.insert(0, SCRIPTS)
+    import postmortem_report
+
+    recs = [
+        {"type": "header", "query_id": "q_x", "trigger": "failure",
+         "state": "FAILED", "error": "boom", "events": 3,
+         "anomalies": [{"kind": "RETRY_STORM", "task_retries": 4}],
+         "nodes": ["http://c:1", "http://w:2"], "unreachable_nodes": ["http://w:3"]},
+        {"type": "query_info", "phase_ledger": {"running_ms": 12.0}},
+        {"type": "journal", "kind": "submit", "query_id": "q_x"},
+        {"type": "event", "seq": 1, "kind": "task_dispatch",
+         "node": "http://c:1", "query_id": "q_x", "ts": 10.0},
+        {"type": "event", "seq": 2, "kind": "task_fail",
+         "node": "http://w:2", "task_id": "q_x_f1_p0_t0", "ts": 10.5,
+         "detail": {"error": "boom"}},
+        {"type": "event", "seq": 3, "kind": "worker_dead",
+         "node": "http://c:1", "ts": 10.6, "detail": {"worker": "http://w:3"}},
+    ]
+    bundle = tmp_path / "bundle.jsonl"
+    bundle.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    out = postmortem_report.render(postmortem_report.load_bundle(str(bundle)))
+    assert "POST-MORTEM  q_x" in out
+    assert "anomaly: RETRY_STORM" in out
+    assert "lane 0: http://c:1" in out and "lane 1: http://w:2" in out
+    assert "unreachable, slice missing" in out  # the dead node is visible
+    # failure events are highlighted with a leading '!'
+    failures = [ln for ln in out.splitlines() if ln.startswith("!")]
+    assert any("task_fail" in ln for ln in failures)
+    assert any("worker_dead" in ln for ln in failures)
+    # both lanes draw their own glyph column
+    assert any("●│" in ln for ln in out.splitlines())
+    assert any("│●" in ln for ln in out.splitlines())
+
+
+# -------------------------------------------------------- metrics_lint unit
+
+
+def test_metrics_lint_fails_both_drift_directions(tmp_path):
+    sys.path.insert(0, SCRIPTS)
+    import metrics_lint
+
+    expo = tmp_path / "expo.txt"
+    expo.write_text(
+        "# HELP trino_tpu_documented_total fine\n"
+        "# TYPE trino_tpu_documented_total counter\n"
+        "trino_tpu_documented_total 1\n"
+        "# HELP trino_tpu_surprise_total exposed but not in the README\n"
+        "# TYPE trino_tpu_surprise_total counter\n"
+        "trino_tpu_surprise_total 1\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "`trino_tpu_documented_total` and `trino_tpu_ghost_total` docs\n"
+    )
+    failures = metrics_lint.lint([str(expo)], str(readme))
+    assert any("trino_tpu_ghost_total" in f and "README documents" in f
+               for f in failures)
+    assert any("trino_tpu_surprise_total" in f and "does not document" in f
+               for f in failures)
+    # fixing the README clears both
+    readme.write_text("`trino_tpu_documented_total` `trino_tpu_surprise_total`\n")
+    assert metrics_lint.lint([str(expo)], str(readme)) == []
+
+
+# ----------------------------------------------------------- cluster drills
+
+
+def _mk_cluster(tmpdir, num_workers=3, heartbeat=0.3, **kw):
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.testing import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(
+        num_workers=num_workers, heartbeat_interval=heartbeat, **kw
+    )
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    runner.coordinator.session.set("exchange_spool_dir", tmpdir)
+    runner.coordinator.session.set("retry_policy", "TASK")
+    runner.coordinator.session.set("result_cache_enabled", "false")
+    return runner
+
+
+def _post_json(url, body=b"{}", timeout=30):
+    req = urllib.request.Request(url, data=body)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+def test_kill_worker_postmortem_bundle(tpch_tiny, oracle):
+    """The chaos drill: kill a worker mid-query under retry_policy=TASK —
+    the query must still succeed, and the post-mortem bundle must contain
+    one correlated timeline with the kill, the retry dispatch, and events
+    from every involved node."""
+    sys.path.insert(0, SCRIPTS)
+    import postmortem_report
+
+    from tests.oracle import assert_rows_equal
+
+    sp = tempfile.mkdtemp(prefix="fr_pm_spool_")
+    # heartbeat slower than the drill: the kill must NOT be detected
+    # before dispatch, so the scheduler hits the dead URL and retries
+    runner = _mk_cluster(sp, num_workers=3, heartbeat=1.0)
+    try:
+        from trino_tpu.utils import flightrecorder as _fr
+
+        sql = (
+            "select l_returnflag, sum(l_quantity) s, count(*) c "
+            "from lineitem group by l_returnflag order by l_returnflag"
+        )
+        runner.query(sql)  # warm caches on all three workers
+        n_dead0 = len(_fr.snapshot(kinds=("worker_dead",)))
+        runner.workers[1].stop()
+        got = runner.query(sql)
+        assert_rows_equal(got, oracle.query(sql))
+        qid = list(runner.coordinator.queries)[-1]
+
+        # the heartbeat marks the killed worker dead within ~2 intervals;
+        # the bundle pulls cluster-scoped worker_dead events into the
+        # query's timeline, so wait for the transition before bundling
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len(_fr.snapshot(kinds=("worker_dead",))) > n_dead0:
+                break
+            time.sleep(0.2)
+
+        pm = _post_json(
+            f"{runner.coordinator.url}/v1/query/{qid}/postmortem"
+        )
+        assert pm["trigger"] == "on_demand"
+        assert os.path.exists(pm["path"])
+
+        recs = postmortem_report.load_bundle(pm["path"])
+        header = next(r for r in recs if r["type"] == "header")
+        events = [r for r in recs if r["type"] == "event"]
+        kinds = {e["kind"] for e in events}
+        # the kill is in the timeline...
+        assert "worker_dead" in kinds, kinds
+        # ...so is the retry that routed around it...
+        assert "task_retry" in kinds, kinds
+        # ...and execution events from >= 2 distinct surviving nodes
+        exec_nodes = {
+            e["node"] for e in events
+            if e["kind"] in ("task_start", "task_finish", "task_fail")
+        }
+        assert len(exec_nodes) >= 2, exec_nodes
+        # every surviving node that ran tasks answered the fan-out
+        assert len(header["nodes"]) >= 2
+        out = postmortem_report.render(recs)
+        assert "TIMELINE" in out and "worker_dead" in out
+        assert "task_retry" in out
+        # the rendered timeline is one merged, ordered view
+        assert f"POST-MORTEM  {qid}" in out
+    finally:
+        runner.stop()
+
+
+@pytest.mark.slow
+def test_anomaly_sentinel_slow_vs_baseline(tpch_tiny):
+    """Sentinel drill: >=3 clean runs build a baseline; a seeded slow
+    re-run is flagged SLOW_VS_BASELINE in QueryInfo, history, /metrics,
+    and the EXPLAIN ANALYZE footer; the next clean re-run is NOT flagged
+    (the anomalous run never enters the baseline)."""
+    sp = tempfile.mkdtemp(prefix="fr_sent_spool_")
+    runner = _mk_cluster(sp, num_workers=2)
+    try:
+        coord = runner.coordinator
+        sql = (
+            "explain analyze select l_returnflag, sum(l_quantity) s "
+            "from lineitem group by l_returnflag order by l_returnflag"
+        )
+        # one extra warm-up keeps the cold compile out of the p95
+        for _ in range(4):
+            runner.query(sql)
+            qid = list(coord.queries)[-1]
+            rec = coord.queries[qid]
+            assert rec.get("anomalies") == [], (
+                "clean/cold runs must never be flagged"
+            )
+        # seed the slowdown: every task on both workers sleeps first
+        for i in range(2):
+            runner.inject_task_failure(
+                i, task_id="*", mode="SLOW", delay_ms=6000, count=10
+            )
+        rows = runner.query(sql)
+        text = "\n".join(r[0] for r in rows)
+        slow_qid = list(coord.queries)[-1]
+        slow_rec = coord.queries[slow_qid]
+        kinds = [a["kind"] for a in slow_rec.get("anomalies") or []]
+        assert "SLOW_VS_BASELINE" in kinds, (kinds, text)
+        # EXPLAIN ANALYZE footer
+        assert "-- anomaly: SLOW_VS_BASELINE" in text, text
+        # QueryInfo over the wire
+        info = _get_json(f"{coord.url}/v1/query/{slow_qid}")
+        assert any(
+            a["kind"] == "SLOW_VS_BASELINE" for a in info["anomalies"]
+        )
+        # history record carries the anomaly (and is baseline-excluded)
+        hist = coord.history.get(slow_qid)
+        assert hist and any(
+            a["kind"] == "SLOW_VS_BASELINE" for a in hist["anomalies"]
+        )
+        # the sentinel metric moved
+        with urllib.request.urlopen(f"{coord.url}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert (
+            'trino_tpu_query_anomalies_total{kind="SLOW_VS_BASELINE"}'
+            in metrics
+        )
+        # a flagged run auto-triggers a post-mortem bundle
+        assert slow_rec.get("postmortem_path"), "anomaly must write a bundle"
+        assert 'trino_tpu_postmortem_bundles_total{trigger="anomaly"}' in metrics
+
+        # drain any unconsumed SLOW rules, then a clean re-run: NOT flagged
+        for w in runner.workers:
+            w.fault_injector.clear()
+        runner.query(sql)
+        clean_qid = list(coord.queries)[-1]
+        assert coord.queries[clean_qid].get("anomalies") == [], (
+            "clean re-run after a flagged one must not be flagged"
+        )
+    finally:
+        runner.stop()
+
+
+@pytest.mark.slow
+def test_postmortem_bundle_survives_coordinator_restart(tpch_tiny):
+    """The bundle lives in the spool, not coordinator memory: a restarted
+    coordinator (same port, same spool dir) still serves it."""
+    sp = tempfile.mkdtemp(prefix="fr_restart_spool_")
+    runner = _mk_cluster(sp, num_workers=2)
+    try:
+        runner.query("select count(*) from orders")
+        qid = list(runner.coordinator.queries)[-1]
+        pm = _post_json(
+            f"{runner.coordinator.url}/v1/query/{qid}/postmortem"
+        )
+        assert os.path.exists(pm["path"])
+
+        port = runner.kill_coordinator()
+        coord = runner.restart_coordinator(
+            port, session={"exchange_spool_dir": sp}
+        )
+        blob = urllib.request.urlopen(
+            f"{coord.url}/v1/query/{qid}/postmortem", timeout=10
+        ).read().decode()
+        header = json.loads(blob.splitlines()[0])
+        assert header["type"] == "header" and header["query_id"] == qid
+    finally:
+        runner.stop()
+
+
+@pytest.mark.slow
+def test_query_info_concurrent_reads_during_run(tpch_tiny):
+    """Regression for the stats-fold race discipline extended to the new
+    anomalies/progress fields: two reader threads hammer /v1/query/{id}
+    and /progress WHILE the query runs and folds stats — every response
+    must parse and be internally consistent, no 500s, no torn dicts."""
+    sp = tempfile.mkdtemp(prefix="fr_race_spool_")
+    runner = _mk_cluster(sp, num_workers=2)
+    try:
+        coord = runner.coordinator
+        # slow every task down so the readers overlap live execution
+        for i in range(2):
+            runner.inject_task_failure(
+                i, task_id="*", mode="SLOW", delay_ms=800, count=10
+            )
+        before = set(coord.queries)
+        errors: list[str] = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                new = [q for q in list(coord.queries) if q not in before]
+                if not new:
+                    time.sleep(0.01)
+                    continue
+                qid = new[-1]
+                for path in (f"/v1/query/{qid}", f"/v1/query/{qid}/progress"):
+                    try:
+                        info = _get_json(f"{coord.url}{path}", timeout=10)
+                    except urllib.error.HTTPError as e:
+                        if e.code != 404:  # not yet registered is fine
+                            errors.append(f"{path}: HTTP {e.code}")
+                        continue
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{path}: {e}")
+                        continue
+                    if path.endswith("/progress"):
+                        frac = info.get("fraction")
+                        if frac is not None and not (0.0 <= frac <= 1.0):
+                            errors.append(f"fraction out of range: {frac}")
+                        for st in (info.get("stages") or {}).values():
+                            if st["completed"] > st["total"]:
+                                errors.append(f"torn stage: {st}")
+                    else:
+                        if not isinstance(info.get("anomalies", []), list):
+                            errors.append("anomalies not a list")
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            got = runner.query(
+                "select l_returnflag, count(*) from lineitem "
+                "group by l_returnflag"
+            )
+            assert len(got) == 3
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors[:10]
+        # after the run the progress endpoint reports completion
+        qid = list(coord.queries)[-1]
+        pg = _get_json(f"{coord.url}/v1/query/{qid}/progress")
+        assert pg["fraction"] == 1.0 and pg["eta_s"] == 0.0
+        assert pg["splits_completed"] == pg["splits_total"] > 0
+    finally:
+        runner.stop()

@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..runtime.failure import Backoff
+from ..utils import flightrecorder as _fr
 from ..utils.metrics import GLOBAL as _METRICS
 
 __all__ = [
@@ -268,10 +269,19 @@ class CompileService:
             fresh = job is None
             if fresh:
                 if not self.breaker.allow(sig):
+                    _fr.record(
+                        "compile_fallback", node="compilesvc",
+                        task_id=fault_task_id, signature=sig,
+                        reason="breaker_open",
+                    )
                     return Outcome("breaker_open", reason="breaker_open")
                 job = _Job(key=key, sig=sig, created_at=t0)
                 self._inflight[key] = job
                 COMPILE_INFLIGHT.set(len(self._inflight))
+                _fr.record(
+                    "compile_start", node="compilesvc",
+                    task_id=fault_task_id, signature=sig,
+                )
                 self._ensure_pool().submit(
                     self._run_job, job, build, injector, fault_task_id
                 )
@@ -306,10 +316,20 @@ class CompileService:
             now = time.monotonic()
             if deadline_at is not None and now >= deadline_at:
                 self._mark_timeout(job)
+                _fr.record(
+                    "compile_fallback", node="compilesvc",
+                    task_id=fault_task_id, signature=sig,
+                    reason="compile_timeout", waited_s=round(waited, 3),
+                )
                 return Outcome(
                     "timeout", reason="compile_timeout", waited_s=waited
                 )
             if budget_at is not None and now >= budget_at:
+                _fr.record(
+                    "compile_fallback", node="compilesvc",
+                    task_id=fault_task_id, signature=sig,
+                    reason="compile_wait", waited_s=round(waited, 3),
+                )
                 return Outcome(
                     "pending", reason="compile_wait", waited_s=waited
                 )
@@ -355,6 +375,10 @@ class CompileService:
             job.error = exc
             if not job.timed_out:
                 self.breaker.record_failure(job.sig)
+            _fr.record(
+                "compile_error", node="compilesvc", task_id=fault_task_id,
+                signature=job.sig, error=str(exc)[:200],
+            )
         else:
             with self._lock:
                 self._done[job.key] = job.result
@@ -363,6 +387,11 @@ class CompileService:
                     self._done.popitem(last=False)
             if not job.timed_out:
                 self.breaker.record_success(job.sig)
+            _fr.record(
+                "compile_done", node="compilesvc", task_id=fault_task_id,
+                signature=job.sig,
+                compile_s=round(time.monotonic() - job.created_at, 3),
+            )
         finally:
             with self._lock:
                 self._inflight.pop(job.key, None)

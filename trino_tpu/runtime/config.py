@@ -36,6 +36,13 @@ Recognized config.properties keys:
     fleet.lease-ttl-s=10            seconds before an unrenewed lease
                                     expires and peers adopt its queries
     fleet.coordinator-id=c1         stable member id (defaults to random)
+    flightrecorder.enabled=true     process-global flight recorder
+                                    (utils/flightrecorder.py): bounded ring
+                                    of structured runtime events served at
+                                    GET /v1/flightrecorder on every node
+    flightrecorder.ring-size=4096   events held in the ring; overflow drops
+                                    the oldest (counted in
+                                    trino_tpu_flightrecorder_dropped_total)
 
 Connector factories (connector.name=):
     tpch (tpch.scale=), tpcds (tpcds.scale=), memory, blackhole,
@@ -51,7 +58,13 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-__all__ = ["load_properties", "load_catalogs", "NodeConfig", "load_node_config"]
+__all__ = [
+    "load_properties",
+    "load_catalogs",
+    "NodeConfig",
+    "load_node_config",
+    "apply_flightrecorder_config",
+]
 
 
 def load_properties(path: str) -> dict[str, str]:
@@ -155,6 +168,24 @@ class NodeConfig:
         ]
         self.fleet_lease_ttl_s = float(props.get("fleet.lease-ttl-s", "10"))
         self.fleet_coordinator_id = props.get("fleet.coordinator-id", "") or None
+        # flight recorder (utils/flightrecorder.py) — applied to the
+        # process-global ring at node boot
+        self.flightrecorder_enabled = (
+            props.get("flightrecorder.enabled", "true").lower() == "true"
+        )
+        self.flightrecorder_ring_size = int(
+            props.get("flightrecorder.ring-size", "4096")
+        )
+
+
+def apply_flightrecorder_config(cfg: "NodeConfig") -> None:
+    """Push the node's flight-recorder keys onto the process-global ring
+    (server boot path; tests configure the ring directly)."""
+    from ..utils import flightrecorder as _fr
+
+    _fr.configure(
+        ring_size=cfg.flightrecorder_ring_size, enabled=cfg.flightrecorder_enabled
+    )
 
 
 def load_node_config(etc_dir: str) -> NodeConfig:

@@ -20,9 +20,11 @@ reference's COORDINATOR_DISTRIBUTION output stage
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
+import tempfile
 import threading
 import time
 import traceback
@@ -41,6 +43,7 @@ from ..plan.fragmenter import Fragment, fragment_plan
 from ..plan.optimizer import optimize
 from ..plan.planner import Planner
 from ..plan.serde import _encode, plan_to_json
+from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
@@ -210,6 +213,26 @@ class Coordinator:
             "Worker tasks canceled by the post-restart sweep because their "
             "query is not live in the journal",
         )
+        # anomaly sentinel (runtime/history.py baselines): typed anomalies
+        # attached to finished queries whose run regressed vs their
+        # planhash's rolling baseline
+        self._m_anomalies = self.metrics.counter(
+            "trino_tpu_query_anomalies_total",
+            "Typed anomalies the sentinel attached to finished queries, by "
+            "anomaly kind (SLOW_VS_BASELINE / SPILL_REGRESSION / "
+            "RETRY_STORM / COMPILE_STORM)",
+            ("kind",),
+        )
+        self._m_postmortems = self.metrics.counter(
+            "trino_tpu_postmortem_bundles_total",
+            "Cross-node post-mortem bundles written, by trigger "
+            "(failure / anomaly / on_demand)",
+            ("trigger",),
+        )
+        # postmortem bundles are disk-pool leased (runtime/disk.py) against
+        # a small coordinator-side budget — lazily built on first write
+        self._postmortem_pool = None
+        self._postmortem_lock = threading.Lock()
         # query lifecycle events (reference: EventListener SPI fired from
         # QueryMonitor on the coordinator, not the workers)
         self.events = EventListenerManager()
@@ -609,7 +632,13 @@ class Coordinator:
                 except Exception:
                     w.failures += 1
                     det.record_failure(w.url)
+                was_alive = w.alive
                 w.alive = det.is_dispatchable(w.url)
+                if was_alive and not w.alive:
+                    _fr.record(
+                        "worker_dead", node=self.url, worker=w.url,
+                        failures=w.failures,
+                    )
             self._enforce_cluster_memory(cluster_by_query)
             self._enforce_node_memory(mem_snapshots)
             self._enforce_deadlines()
@@ -936,6 +965,10 @@ class Coordinator:
                 # router retry of an already-admitted id: idempotent
                 return qid
             self.queries[qid] = record
+        _fr.record(
+            "query_admit", node=self.url, query_id=qid,
+            spooled=record["spooled"],
+        )
         if self.journal is not None and isinstance(sql, str):
             # admission is the journal's birth record: a crash after this
             # point leaves enough (SQL + explicit session overrides) to
@@ -1080,6 +1113,23 @@ class Coordinator:
                 self.history.record(self._history_record(record, wall))
             except Exception:
                 traceback.print_exc()
+            _fr.record(
+                "query_finish", node=self.url, query_id=sm.query_id,
+                state=sm.state, wall_ms=round(wall * 1e3, 3),
+                anomalies=[a["kind"] for a in record.get("anomalies") or []]
+                or None,
+            )
+            # post-mortem bundle: typed failure or a sentinel-flagged run
+            # fans out to every node that touched the query and writes one
+            # correlated JSONL bundle under the spool dir — never fails
+            # the query it documents
+            try:
+                if sm.state == "FAILED":
+                    self._write_postmortem(record, trigger="failure")
+                elif record.get("anomalies"):
+                    self._write_postmortem(record, trigger="anomaly")
+            except Exception:
+                traceback.print_exc()
 
     def _history_record(self, record: dict, wall_s: float) -> dict:
         """JSON-able completed-query snapshot for the history store: the
@@ -1107,8 +1157,11 @@ class Coordinator:
             "rows": len(record["result"] or []),
             # result-cache provenance: planhash feeds history-driven
             # admission (ResultCache.admissible counts recurrences of it);
-            # cached marks hits — which still land here, by design
-            "planhash": (record.get("cache") or {}).get("planhash"),
+            # cached marks hits — which still land here, by design.  With
+            # the result cache disabled no plan was hashed — the anomaly
+            # sentinel still needs a stable per-statement key, so the SQL
+            # hash stands in (QueryHistoryStore.baseline matches on it)
+            "planhash": self._baseline_key(record),
             "cached": bool(record.get("cached")),
             # plan-cache provenance: the EXECUTE's resolved template feeds
             # FastPath._recurring_templates fleet-wide (shared history)
@@ -1158,6 +1211,385 @@ class Coordinator:
             # planning/running) but zero cluster execution
             ledger["cached"] = True
         return ledger
+
+    # ----------------------------------------------------- anomaly sentinel
+    def _baseline_key(self, record: dict) -> Optional[str]:
+        """Stable per-statement baseline key: the optimizer plan hash when
+        the result-cache hook computed one, else a hash of the SQL text —
+        so the sentinel works even with result_cache_enabled=false (where
+        repeated identical queries would otherwise have no key at all)."""
+        ph = (record.get("cache") or {}).get("planhash")
+        if ph:
+            return ph
+        sql = record.get("sql")
+        if isinstance(sql, str) and sql:
+            return "sql:" + hashlib.sha1(sql.encode()).hexdigest()[:16]
+        # planned submissions (EXPLAIN ANALYZE hands the coordinator an
+        # AST, not text): the static per-stage plan text is stable across
+        # runs of the same statement and stands in as the plan hash
+        qi = record.get("query_info") or {}
+        parts: list[str] = []
+        for st in qi.get("stages") or []:
+            plan = st.get("plan") or ""
+            parts.append(
+                "\n".join(plan) if isinstance(plan, list) else str(plan)
+            )
+        # ANALYZE runs store plans with per-run [rows, ms] annotations —
+        # strip them or identical statements never share a baseline key
+        plans = re.sub(r"\s*\[rows: [^\]]*\]", "", "\n".join(parts))
+        if plans.strip():
+            return "plan:" + hashlib.sha1(plans.encode()).hexdigest()[:16]
+        return None
+
+    def _score_anomalies(self, record: dict) -> None:
+        """Anomaly sentinel: score the finished run against its planhash's
+        rolling baseline (QueryHistoryStore.baseline) and attach typed
+        anomalies to QueryInfo.  Runs BEFORE the history record is written,
+        so flagged runs are excluded from future baselines and a clean
+        re-run after a flagged one is not dragged into a false positive.
+        Below anomaly_min_samples the sentinel stays silent — a cold
+        baseline must never flag."""
+        qi = record.get("query_info")
+        if qi is None or not bool(self.session.get("anomaly_detection_enabled")):
+            return
+        record["anomalies"] = qi["anomalies"] = []
+        key = self._baseline_key(record)
+        if not key or record.get("cached"):
+            return  # cache hits did no cluster work — nothing to score
+        base = self.history.baseline(
+            key, min_samples=int(self.session.get("anomaly_min_samples") or 3)
+        )
+        qi["baseline"] = base
+        if base is None:
+            return
+        anomalies: list[dict] = []
+        factor = float(self.session.get("anomaly_slow_factor") or 2.0)
+        wall = float(qi.get("wall_ms") or 0.0)
+        p50, p95 = base["wall_ms_p50"], base["wall_ms_p95"]
+        min_delta = float(self.session.get("anomaly_min_wall_delta_ms") or 0.0)
+        if wall > max(p95, factor * p50) and wall - p50 >= min_delta:
+            anomalies.append({
+                "kind": "SLOW_VS_BASELINE", "wall_ms": wall,
+                "baseline_p50_ms": p50, "baseline_p95_ms": p95,
+                "factor": round(wall / p50, 2) if p50 else None,
+            })
+        spill = float(qi.get("spill_ms") or 0.0)
+        spill_min = float(self.session.get("anomaly_spill_min_ms") or 0.0)
+        if spill > spill_min and spill > factor * base["spill_ms_p50"]:
+            anomalies.append({
+                "kind": "SPILL_REGRESSION", "spill_ms": spill,
+                "baseline_p50_ms": base["spill_ms_p50"],
+            })
+        retries = int(qi.get("task_retries") or 0)
+        storm = int(self.session.get("anomaly_retry_storm_threshold") or 3)
+        if retries >= storm and base["retries_p50"] < storm:
+            anomalies.append({
+                "kind": "RETRY_STORM", "task_retries": retries,
+                "baseline_p50": base["retries_p50"],
+            })
+        compiles = sum(
+            int(agg.get("compiles") or 0)
+            for agg in (qi.get("compile_signatures") or {}).values()
+        )
+        qi["compile_count"] = compiles  # rides into history for baselines
+        cmin = int(self.session.get("anomaly_compile_storm_min") or 2)
+        cp50 = base["compiles_p50"]
+        if compiles > max(2 * cp50, cp50 + cmin):
+            anomalies.append({
+                "kind": "COMPILE_STORM", "compile_count": compiles,
+                "baseline_p50": cp50,
+            })
+        record["anomalies"] = qi["anomalies"] = anomalies
+        for a in anomalies:
+            self._m_anomalies.labels(a["kind"]).inc()
+            _fr.record(
+                "anomaly", node=self.url, query_id=record["sm"].query_id,
+                anomaly=a["kind"],
+                **{k: v for k, v in a.items() if k != "kind"},
+            )
+
+    # ---------------------------------------------------- post-mortem bundle
+    def _postmortem_dir(self) -> str:
+        """Bundle root: the spooled-exchange dir when configured (the
+        postmortem_* namespace is age-GC'd by the same spool sweep as
+        memo_*), else a stable tmp fallback so failures are still
+        documented on spool-less deployments."""
+        return self.session.get("exchange_spool_dir") or os.path.join(
+            tempfile.gettempdir(), "trino_tpu_postmortem"
+        )
+
+    def postmortem_path(self, qid: str) -> str:
+        return os.path.join(
+            self._postmortem_dir(), f"postmortem_{qid}", "bundle.jsonl"
+        )
+
+    def _query_nodes(self, record: Optional[dict]) -> list[str]:
+        """Every worker URL that touched the query (from the dispatch
+        ledger), falling back to the whole membership when the record is
+        gone (on-demand post-mortem of an expired query — each node's
+        flight-recorder slice filters by query id anyway)."""
+        urls: list[str] = []
+        tu = (record or {}).get("task_urls") or {}
+        for lst in tu.values():
+            for u, _tid in lst:
+                if u != SPOOL_URL and u not in urls:
+                    urls.append(u)
+        if not urls:
+            with self._lock:
+                urls = list(self.workers)
+        return urls
+
+    def _journal_lines(self, qid: str) -> list[dict]:
+        """This query's raw journal records (admit/dispatch/commit/finish)
+        for the bundle — read back from the JSONL file, best-effort."""
+        if self.journal is None:
+            return []
+        out = []
+        try:
+            with open(self.journal.path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("query_id") == qid:
+                        out.append(rec)
+        except OSError:
+            pass
+        return out
+
+    def write_postmortem(self, qid: str, trigger: str) -> Optional[dict]:
+        """On-demand bundle (POST /v1/query/{id}/postmortem): works from
+        the live record when the query is still tracked, else from its
+        history snapshot."""
+        with self._lock:
+            record = self.queries.get(qid)
+        if record is not None:
+            return self._write_postmortem(record, trigger=trigger)
+        hist = self.history.get(qid)
+        if hist is None:
+            return None
+        pseudo = {
+            "sm": None, "query_id": qid, "sql": hist.get("sql"),
+            "query_info": hist, "anomalies": hist.get("anomalies"),
+            "trace_id": hist.get("trace_id"),
+            "_state": hist.get("state"), "_error": hist.get("error"),
+        }
+        return self._write_postmortem(pseudo, trigger=trigger)
+
+    def _write_postmortem(self, record: dict, trigger: str) -> Optional[dict]:
+        """Fan out to every node that touched the query, collect each
+        node's flight-recorder slice, and write ONE correlated JSONL
+        bundle (header + QueryInfo/phase ledger + journal records + every
+        node's events) under the spool dir.  The bundle dir is disk-pool
+        leased and lives in the postmortem_* namespace the spool GC ages
+        out like memo_*; GET /v1/query/{id}/postmortem serves the file —
+        including after a coordinator restart."""
+        if not bool(self.session.get("postmortem_enabled")):
+            return None
+        sm = record.get("sm")
+        qid = sm.query_id if sm is not None else record["query_id"]
+        state = sm.state if sm is not None else record.get("_state")
+        error = sm.error if sm is not None else record.get("_error")
+        # collect per-node lanes: each worker's endpoint serves only its
+        # own aliases, the coordinator lane is everything minus what the
+        # workers already claimed ((node, seq) dedup — in-process clusters
+        # share one ring, separate processes have disjoint ones)
+        events: list[dict] = []
+        claimed: set[tuple] = set()
+        nodes: list[str] = []
+        dead_nodes: list[str] = []
+        for wurl in self._query_nodes(record):
+            try:
+                with urllib.request.urlopen(
+                    f"{wurl}/v1/flightrecorder?query_id={qid}", timeout=3
+                ) as r:
+                    slice_ = json.loads(r.read()).get("events") or []
+            except Exception:
+                # a killed worker cannot answer — its lane is absent and
+                # noted in the header (in-process kills keep the shared
+                # ring, so the coordinator lane below still has its events)
+                dead_nodes.append(wurl)
+                continue
+            nodes.append(wurl)
+            for ev in slice_:
+                key = (ev.get("node"), ev.get("seq"))
+                if key in claimed:
+                    continue
+                claimed.add(key)
+                events.append(ev)
+        for ev in _fr.snapshot(query_id=qid):
+            key = (ev.get("node"), ev.get("seq"))
+            if key not in claimed:
+                claimed.add(key)
+                events.append(ev)
+        # cluster-scoped events carry no query id but are exactly what a
+        # post-mortem reader needs: the worker death that caused the
+        # retries belongs in this query's timeline
+        for ev in _fr.snapshot(kinds=("worker_dead",)):
+            key = (ev.get("node"), ev.get("seq"))
+            if key not in claimed:
+                claimed.add(key)
+                events.append(ev)
+        events.sort(key=lambda e: e.get("seq") or 0)
+        qi = dict(record.get("query_info") or {})
+        qi.pop("workers", None)
+        sql = record.get("sql")
+        header = {
+            "type": "header",
+            "query_id": qid,
+            "written_ts": time.time(),
+            "trigger": trigger,
+            "state": state,
+            "error": error,
+            "anomalies": record.get("anomalies") or [],
+            "sql": sql[:500] if isinstance(sql, str) else (
+                "<planned>" if sql is not None else None
+            ),
+            "trace_id": record.get("trace_id") or "",
+            "coordinator": self.url,
+            "nodes": [self.url] + nodes,
+            "unreachable_nodes": dead_nodes,
+            "events": len(events),
+        }
+        lines = [json.dumps(header, default=str)]
+        lines.append(json.dumps(dict(qi, type="query_info"), default=str))
+        for jrec in self._journal_lines(qid):
+            lines.append(json.dumps(dict(jrec, type="journal"), default=str))
+        ev_lines = [
+            json.dumps(dict(ev, type="event"), default=str) for ev in events
+        ]
+        budget = int(self.session.get("postmortem_budget_bytes") or 16 << 20)
+        base = sum(len(ln) + 1 for ln in lines)
+        kept, total, dropped = [], base, 0
+        for ln in reversed(ev_lines):  # keep the newest events under budget
+            if total + len(ln) + 1 > budget:
+                dropped += 1
+                continue
+            total += len(ln) + 1
+            kept.append(ln)
+        kept.reverse()
+        if dropped:
+            header["events_dropped"] = dropped
+            lines[0] = json.dumps(header, default=str)
+        lines.extend(kept)
+        body = ("\n".join(lines) + "\n").encode()
+        path = self.postmortem_path(qid)
+        bdir = os.path.dirname(path)
+        # disk-pool lease: bundle bytes count against a small coordinator
+        # budget; the lease's path auto-harvests when the spool GC ages
+        # the postmortem_* dir out (runtime/disk.py _refresh_locked)
+        from .disk import DiskExceeded, NodeDiskPool
+
+        with self._postmortem_lock:
+            if self._postmortem_pool is None:
+                self._postmortem_pool = NodeDiskPool(
+                    capacity_bytes=max(
+                        int(self.session.get("postmortem_budget_bytes")
+                            or 16 << 20) * 8,
+                        64 << 20,
+                    ),
+                    name=f"postmortem:{self.port}",
+                )
+        try:
+            self._postmortem_pool.reserve(
+                owner=f"postmortem_{qid}", nbytes=len(body),
+                timeout_s=0.5, what="postmortem bundle", path=bdir,
+            )
+        except DiskExceeded:
+            return None  # budget full: shed the bundle, never the query
+        try:
+            os.makedirs(bdir, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(body)
+        except OSError:
+            traceback.print_exc()
+            return None
+        if record.get("sm") is not None:
+            record["postmortem_path"] = path
+        self._m_postmortems.labels(trigger).inc()
+        out = {
+            "path": path, "nodes": header["nodes"],
+            "unreachable_nodes": dead_nodes, "events": len(kept),
+            "trigger": trigger,
+        }
+        _fr.record(
+            "postmortem", node=self.url, query_id=qid, trigger=trigger,
+            path=path, events=len(kept), nodes=len(header["nodes"]),
+        )
+        return out
+
+    # ------------------------------------------------------- query progress
+    def _progress_stage_begin(
+        self, record: dict, fid: int, total: int, precommitted: int = 0
+    ) -> None:
+        with self._lock:
+            prog = record.setdefault(
+                "progress", {"stages": {}, "started_ts": time.time()}
+            )
+            prog["stages"][fid] = {
+                "total": int(total),
+                "completed": int(precommitted),
+                "rows_out": 0,
+                "output_bytes": 0,
+            }
+
+    def _progress_part_done(
+        self, record: dict, fid: int, winner: tuple[str, str]
+    ) -> None:
+        """One split/task completed: bump the stage's completion count and
+        fold the attempt's rows/bytes in from its final status (fields are
+        ASSEMBLED under the lock, the status HTTP call runs outside it —
+        the PR 5 stats-fold discipline)."""
+        url, task_id = winner
+        st = {} if url == SPOOL_URL else (
+            self._task_info(url, task_id).get("stats") or {}
+        )
+        with self._lock:
+            stage = (record.get("progress") or {}).get("stages", {}).get(fid)
+            if stage is None:
+                return
+            stage["completed"] += 1
+            stage["rows_out"] += int(st.get("rows_out") or 0)
+            stage["output_bytes"] += int(st.get("output_bytes") or 0)
+
+    def query_progress(self, qid: str) -> Optional[dict]:
+        """GET /v1/query/{id}/progress: split/task completion fraction,
+        per-stage rows/bytes, and a naive rate-based ETA.  Assembled under
+        the lock, serialized by the caller outside it."""
+        with self._lock:
+            record = self.queries.get(qid)
+            if record is None:
+                return None
+            sm: QueryStateMachine = record["sm"]
+            prog = record.get("progress") or {}
+            stages = {
+                str(fid): dict(st)
+                for fid, st in (prog.get("stages") or {}).items()
+            }
+            out = {
+                "query_id": qid,
+                "state": sm.state,
+                "started_ts": prog.get("started_ts"),
+                "stages": stages,
+                "anomalies": [
+                    a["kind"] for a in record.get("anomalies") or []
+                ],
+            }
+        total = sum(s["total"] for s in stages.values())
+        done = sum(s["completed"] for s in stages.values())
+        frac = (done / total) if total else (1.0 if sm.done else 0.0)
+        out["splits_total"] = total
+        out["splits_completed"] = done
+        out["fraction"] = round(1.0 if sm.done else frac, 4)
+        if sm.done:
+            out["eta_s"] = 0.0
+        elif prog.get("started_ts") and 0 < frac < 1:
+            elapsed = time.time() - prog["started_ts"]
+            out["eta_s"] = round(elapsed * (1 - frac) / frac, 2)
+        else:
+            out["eta_s"] = None  # no completions yet: no rate to project
+        return out
 
     def _run_inner(self, record: dict) -> None:
         sm: QueryStateMachine = record["sm"]
@@ -1534,6 +1966,9 @@ class Coordinator:
         spool_dir = self.session.get("exchange_spool_dir") or ""
         spool = SpooledExchange(spool_dir) if (spool_dir and phased) else None
         task_urls: dict[int, list[tuple[str, str]]] = {}  # frag -> [(url, task_id)]
+        # the post-mortem fan-out reads this to learn which nodes touched
+        # the query (the dict mutates in place as stages complete)
+        record["task_urls"] = task_urls
         frag_meta: dict[int, tuple[dict, str]] = {}  # frag -> (payload_base, tag)
         all_tasks: list[tuple[str, str]] = []
         heal_seq = [0]
@@ -1563,6 +1998,14 @@ class Coordinator:
             for i in dead:
                 self._m_heals.inc()
                 record["task_heals"] = record.get("task_heals", 0) + 1
+                _fr.record(
+                    "task_heal", node=self.url, query_id=sm.query_id,
+                    task_id=urls_list[i][1], dead_worker=urls_list[i][0],
+                    committed=bool(
+                        spool is not None
+                        and spool.is_committed(urls_list[i][1])
+                    ),
+                )
                 if spool is not None and spool.is_committed(urls_list[i][1]):
                     urls_list[i] = (SPOOL_URL, urls_list[i][1])
                     moved = True
@@ -1627,6 +2070,10 @@ class Coordinator:
             self._m_spool_repro.inc()
             record["spool_reproductions"] = (
                 record.get("spool_reproductions", 0) + 1
+            )
+            _fr.record(
+                "spool_reproduce", node=self.url, query_id=sm.query_id,
+                task_id=lost_tid, count=n,
             )
             # clear the corrupt/partial partition so the reproduction's
             # commit rename lands (first-commit-wins would otherwise treat
@@ -1846,8 +2293,11 @@ class Coordinator:
                         self.session.get("split_queue_depth") or 2
                     ),
                     is_parked=self._split_parked,
+                    query_id=sm.query_id,
+                    node=self.url,
                 )
                 max_att = int(self.session.get("split_retry_limit") or 0) + 1
+            self._progress_stage_begin(record, f.id, ntasks[f.id], len(pre))
             try:
                 urls = self._run_stage_phased(
                     payload_base,
@@ -1868,6 +2318,9 @@ class Coordinator:
                     on_part_done=on_commit if spool is not None else None,
                     split_sched=sched,
                     on_task_failed=on_task_failed if spool is not None else None,
+                    on_progress=lambda p, winner, fid=f.id: (
+                        self._progress_part_done(record, fid, winner)
+                    ),
                 )
             finally:
                 if sched is not None:
@@ -1927,6 +2380,10 @@ class Coordinator:
                         )
                     t0 = time.perf_counter() - t_query0
                     payload_base, tag = build_payload(f)
+                    # all-at-once posts fire-and-forget: progress reports
+                    # the dispatch totals; completion lands when the root
+                    # fetch drains the stage (fraction forced to 1 on done)
+                    self._progress_stage_begin(record, f.id, ntasks[f.id])
                     urls = []
                     for p in range(ntasks[f.id]):
                         w = workers[p % nw]
@@ -1964,7 +2421,7 @@ class Coordinator:
                 def fetch_one(u: str, t: str) -> list[bytes]:
                     if u == SPOOL_URL:
                         return spool.read_chunks(t, 0)
-                    return _stream_fetch(u, t, 0)
+                    return _stream_fetch(u, t, 0, node=self.url)
 
                 for i in range(len(task_urls[child_id])):
                     u, t = task_urls[child_id][i]
@@ -2009,6 +2466,14 @@ class Coordinator:
                     record, fragments, ntasks, task_urls, executor,
                     stage_times, t_query0,
                 )
+            except Exception:
+                traceback.print_exc()
+            # anomaly sentinel scores HERE — before the EXPLAIN ANALYZE
+            # renderer reads query_info (the "-- anomaly:" footer) and
+            # before the history record is cut (flagged runs must not
+            # poison their own baseline)
+            try:
+                self._score_anomalies(record)
             except Exception:
                 traceback.print_exc()
             if record.get("spooled"):
@@ -2335,6 +2800,7 @@ class Coordinator:
         on_part_done=None,
         split_sched: Optional[SplitScheduler] = None,
         on_task_failed=None,
+        on_progress=None,
     ) -> list[tuple[str, str]]:
         """Post one stage's tasks, poll statuses, and re-schedule individual
         failures onto other alive workers (task-level recovery).  Every
@@ -2456,6 +2922,8 @@ class Coordinator:
                     urls[p] = winner
                     if on_part_done is not None:
                         on_part_done(p, winner[1])
+                    if on_progress is not None:
+                        on_progress(p, winner)
                     durations.append(time.monotonic() - started[p])
                     for a in atts:  # abort the speculation loser
                         if a != winner:
@@ -2509,6 +2977,12 @@ class Coordinator:
                                     ),
                                 ):
                                     self._m_speculative.labels("launched").inc()
+                                    _fr.record(
+                                        "task_speculate", node=self.url,
+                                        query_id=payload_base.get("query_id"),
+                                        task_id=tid, backup_worker=w,
+                                        original_worker=u0,
+                                    )
                                     backup_worker[p] = w
                                     pending[p] = still + [(w, tid)]
                     continue
@@ -2525,10 +2999,22 @@ class Coordinator:
                 attempts[p] += 1
                 backup_worker.pop(p, None)
                 if attempts[p] >= max_attempts:
+                    _fr.record(
+                        "task_failed", node=self.url,
+                        query_id=payload_base.get("query_id"),
+                        task_id=atts[0][1], attempts=attempts[p],
+                        worker=atts[-1][0],
+                    )
                     raise RuntimeError(
                         f"task {atts[0][1]} failed {attempts[p]} times"
                     )
                 self._m_retries.inc()
+                _fr.record(
+                    "task_retry", node=self.url,
+                    query_id=payload_base.get("query_id"),
+                    task_id=atts[0][1], attempt=attempts[p],
+                    failed_worker=atts[-1][0],
+                )
                 if on_retry is not None:
                     on_retry()
                 bad_url = atts[-1][0]
@@ -2695,6 +3181,12 @@ class Coordinator:
 
     def _post_task(self, worker_url: str, payload: dict) -> None:
         self._m_dispatched.inc()
+        _fr.record(
+            "task_dispatch", node=self.url,
+            query_id=payload.get("query_id"),
+            task_id=payload.get("task_id"), worker=worker_url,
+            part=payload.get("part"), attempt=payload.get("attempt"),
+        )
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
             f"{worker_url}/v1/task/{payload['task_id']}",
@@ -2918,6 +3410,19 @@ def _make_handler(coord: Coordinator):
                     200,
                     {"id": qid, "nextUri": f"{coord.url}/v1/statement/{qid}/0"},
                 )
+            if (
+                parts[:2] == ["v1", "query"] and len(parts) >= 4
+                and parts[3] == "postmortem"
+            ):
+                # on-demand bundle: fan out and write NOW (works for live
+                # and history-expired queries)
+                out = coord.write_postmortem(parts[2], trigger="on_demand")
+                if out is None:
+                    return self._send_json(
+                        404,
+                        {"error": "unknown query or postmortem disabled"},
+                    )
+                return self._send_json(200, out)
             if parts[:2] == ["v1", "announce"]:
                 req = json.loads(body)
                 if req.get("event") == "goodbye":
@@ -2964,12 +3469,36 @@ def _make_handler(coord: Coordinator):
                 # both tables snapshot under the lock: workers and queries
                 # mutate from the heartbeat/announce threads, and iterating
                 # a mutating dict here raced (RuntimeError mid-render)
+                def _progress_cell(rec) -> str:
+                    # split/task completion fraction from the live
+                    # progress ledger (GET /v1/query/{id}/progress)
+                    if rec["sm"].done:
+                        return "<td>100%</td>"
+                    stages = (rec.get("progress") or {}).get("stages") or {}
+                    total = sum(s["total"] for s in stages.values())
+                    done = sum(s["completed"] for s in stages.values())
+                    if not total:
+                        return "<td>-</td>"
+                    return f"<td>{100.0 * done / total:.0f}%</td>"
+
+                def _anomaly_cell(src) -> str:
+                    kinds = [
+                        a.get("kind") for a in src.get("anomalies") or []
+                        if isinstance(a, dict)
+                    ]
+                    return (
+                        f"<td>{_html.escape(','.join(kinds))}</td>"
+                        if kinds else "<td>-</td>"
+                    )
+
                 with coord._lock:
                     qrows = "".join(
                         f"<tr><td>{_html.escape(str(qid))}</td>"
                         f"<td>{_html.escape(rec['sm'].state)}</td>"
                         f"{_age(rec['sm'])}"
+                        f"{_progress_cell(rec)}"
                         f"<td>{'hit' if rec.get('cached') else '-'}</td>"
+                        f"{_anomaly_cell(rec)}"
                         f"<td>{_html.escape(str(rec.get('adopted_from') or '-'))}</td>"
                         f"<td><code>{_html.escape(str(rec.get('sql'))[:120])}</code></td></tr>"
                         for qid, rec in list(coord.queries.items())[-50:]
@@ -3029,6 +3558,7 @@ def _make_handler(coord: Coordinator):
                     f"<td>{float(h.get('wall_s') or 0.0):.2f}</td>"
                     f"<td>{float((h.get('phase_ledger') or {}).get('compiling_ms') or 0.0):.0f}</td>"
                     f"<td>{'hit' if h.get('cached') else '-'}</td>"
+                    f"{_anomaly_cell(h)}"
                     f"<td><code>{_html.escape(str(h.get('sql'))[:120])}</code></td></tr>"
                     for h in coord.history.list(limit=20)
                 )
@@ -3047,12 +3577,14 @@ def _make_handler(coord: Coordinator):
                     f"{fleet_html}"
                     f"<h3>queries ({nqueries})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
-                    "<th>in state (s)</th><th>cache</th><th>origin</th>"
+                    "<th>in state (s)</th><th>progress</th><th>cache</th>"
+                    "<th>anomalies</th><th>origin</th>"
                     "<th>sql</th></tr>"
                     f"{qrows}</table>"
                     f"<h3>history ({len(coord.history)})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
-                    "<th>compile (ms)</th><th>cache</th><th>sql</th></tr>"
+                    "<th>compile (ms)</th><th>cache</th><th>anomalies</th>"
+                    "<th>sql</th></tr>"
                     f"{hrows}</table></body></html>"
                 ).encode()
                 self.send_response(200)
@@ -3150,8 +3682,27 @@ def _make_handler(coord: Coordinator):
                                 "stage_times": dict(
                                     record.get("stage_times") or {}
                                 ),
+                                # sentinel verdict + live progress: deep-
+                                # copied under the lock like every other
+                                # mutable field here (the scheduler thread
+                                # mutates progress stages mid-request)
+                                "anomalies": [
+                                    dict(a)
+                                    for a in record.get("anomalies") or []
+                                ],
+                                "progress": {
+                                    str(fid): dict(st)
+                                    for fid, st in (
+                                        (record.get("progress") or {})
+                                        .get("stages") or {}
+                                    ).items()
+                                },
                             }
                         )
+                        if record.get("postmortem_path"):
+                            info["postmortem"] = (
+                                f"{coord.url}/v1/query/{parts[2]}/postmortem"
+                            )
                 if info is None:
                     # expired from the live table: serve the history record
                     # instead of 404ing (reference: QueryResource keeps
@@ -3161,6 +3712,50 @@ def _make_handler(coord: Coordinator):
                         return self._send_json(404, {"error": "unknown query"})
                     info = dict(hist, expired=True)
                 return self._send_json(200, info)
+            if parts == ["v1", "flightrecorder"]:
+                # the coordinator is the collector: serve EVERY lane in
+                # this process's ring (in-process clusters share it; the
+                # post-mortem fan-out dedups by (node, seq))
+                events = _fr.snapshot(
+                    query_id=(params.get("query_id") or [None])[0],
+                )
+                return self._send_json(
+                    200,
+                    {"node": coord.url, "stats": _fr.stats(),
+                     "events": events},
+                )
+            if (
+                parts[:2] == ["v1", "query"] and len(parts) >= 4
+                and parts[3] == "progress"
+            ):
+                prog = coord.query_progress(parts[2])
+                if prog is None:
+                    return self._send_json(404, {"error": "unknown query"})
+                return self._send_json(200, prog)
+            if (
+                parts[:2] == ["v1", "query"] and len(parts) >= 4
+                and parts[3] == "postmortem"
+            ):
+                # serve the raw bundle JSONL — the path derives from the
+                # configured spool dir, so a restarted coordinator keeps
+                # answering for pre-crash bundles
+                with coord._lock:
+                    record = coord.queries.get(parts[2])
+                    ppath = (record or {}).get("postmortem_path")
+                ppath = ppath or coord.postmortem_path(parts[2])
+                try:
+                    with open(ppath, "rb") as f:
+                        blob = f.read()
+                except OSError:
+                    return self._send_json(
+                        404, {"error": "no postmortem bundle for this query"}
+                    )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+                return
             if parts[:2] == ["v1", "query"] and len(parts) >= 4 and parts[3] == "state":
                 # cheap state probe: never serializes result rows
                 with coord._lock:

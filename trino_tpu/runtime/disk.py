@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..utils import flightrecorder as _fr
 from ..utils.metrics import GLOBAL as _METRICS
 
 __all__ = ["DiskExceeded", "DiskLease", "NodeDiskPool", "guarded_write"]
@@ -176,6 +177,10 @@ class NodeDiskPool:
                 self.reclaims += 1
                 self.reclaimed_bytes += freed
             _RECLAIMED.inc(freed)
+            _fr.record(
+                "disk_reclaim", node=self.name, task_id=owner,
+                freed_bytes=freed, needed_bytes=need,
+            )
 
         blocked_at: Optional[float] = None
         try:
@@ -188,12 +193,20 @@ class NodeDiskPool:
                     if nbytes > self.capacity:
                         # larger than the whole pool: waiting cannot succeed
                         self._shed_locked()
+                        _fr.record(
+                            "disk_shed", node=self.name, task_id=owner,
+                            bytes=nbytes, what=what,
+                        )
                         raise DiskExceeded(
                             nbytes, self.reserved, self.capacity, what
                         )
                     if blocked_at is None:
                         blocked_at = time.monotonic()
                         self.blocked += 1
+                        _fr.record(
+                            "disk_block", node=self.name, task_id=owner,
+                            bytes=nbytes, what=what,
+                        )
                     if abort is not None and abort():
                         raise RuntimeError("task canceled")
                     remaining = None
@@ -202,6 +215,11 @@ class NodeDiskPool:
                         if remaining <= 0:
                             self._shed_locked()
                             waited = time.monotonic() - blocked_at
+                            _fr.record(
+                                "disk_shed", node=self.name, task_id=owner,
+                                bytes=nbytes, what=what,
+                                blocked_s=round(waited, 3),
+                            )
                             raise DiskExceeded(
                                 nbytes, self.reserved, self.capacity,
                                 f"{what} (blocked {waited:.1f}s on node "

@@ -832,6 +832,20 @@ class Engine:
             if spl.get("parked"):
                 line += f", park deferrals: {spl['parked']}"
             text.append(line + ")")
+        # anomaly-sentinel footer: present only when the sentinel flagged
+        # this run against its planhash's rolling baseline (coordinator
+        # _score_anomalies over runtime/history.py baselines)
+        for a in info.get("anomalies") or []:
+            base = info.get("baseline") or {}
+            line = f"-- anomaly: {a.get('kind')}"
+            detail = ", ".join(
+                f"{k} {v}" for k, v in sorted(a.items()) if k != "kind"
+            )
+            if detail:
+                line += f" ({detail})"
+            if base.get("samples"):
+                line += f" [baseline: {base['samples']} runs]"
+            text.append(line)
         # fleet footer: present only on queries a surviving fleet member
         # adopted from a dead peer's journal (runtime/fleet.py)
         flt = info.get("fleet") or {}

@@ -151,6 +151,58 @@ class QueryHistoryStore:
             "finished_ts": event.ts,
         })
 
+    # ----------------------------------------------------------- baselines
+    def baseline(self, planhash: str, min_samples: int = 3) -> Optional[dict]:
+        """Rolling per-planhash baseline for the anomaly sentinel
+        (coordinator._score_anomalies): percentile stats over this plan's
+        clean FINISHED runs in the ring.
+
+        Sample selection is deliberately conservative: cache-served runs
+        (no execution happened) and runs already flagged anomalous are
+        excluded, so one slow outlier cannot drag the baseline up and mask
+        the next regression.  Returns None below `min_samples` — a cold
+        sentinel must stay silent rather than false-positive."""
+        if not planhash:
+            return None
+        with self._lock:
+            recs = [
+                r
+                for r in self._ring.values()
+                if r.get("planhash") == planhash
+                and str(r.get("state", "")).upper() == "FINISHED"
+                and not r.get("cached")
+                and not r.get("anomalies")
+            ]
+        if len(recs) < max(1, int(min_samples)):
+            return None
+
+        def _vals(key: str) -> list[float]:
+            out = []
+            for r in recs:
+                v = r.get(key)
+                if isinstance(v, (int, float)):
+                    out.append(float(v))
+            return sorted(out)
+
+        def _pct(vals: list[float], q: float) -> float:
+            if not vals:
+                return 0.0
+            i = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+            return vals[i]
+
+        walls = _vals("wall_ms")
+        return {
+            "planhash": planhash,
+            "samples": len(recs),
+            "wall_ms_p50": round(_pct(walls, 0.5), 3),
+            "wall_ms_p95": round(_pct(walls, 0.95), 3),
+            "spill_ms_p50": round(_pct(_vals("spill_ms"), 0.5), 3),
+            "retries_p50": _pct(_vals("task_retries"), 0.5),
+            "compiles_p50": _pct(_vals("compile_count"), 0.5),
+            "peak_bytes_p50": _pct(_vals("peak_memory_bytes"), 0.5),
+            "rows_p50": _pct(_vals("rows"), 0.5),
+        }
+
     # ---------------------------------------------------------------- read
     def get(self, qid: str) -> Optional[dict]:
         with self._lock:

@@ -47,6 +47,7 @@ __all__ = [
     "ClusterMemoryManager",
 ]
 
+from ..utils import flightrecorder as _fr
 from ..utils.metrics import GLOBAL as _METRICS
 
 # over-free detection (a double-free that silently clamps to zero hides a
@@ -267,6 +268,10 @@ class NodeMemoryPool:
                 if blocked_at is None:
                     blocked_at = time.monotonic()
                     self.blocked += 1
+                    _fr.record(
+                        "memory_block", node=self.name, query_id=query_id,
+                        bytes=nbytes, what=what,
+                    )
                     if on_block is not None:
                         on_block()
                 if abort is not None and abort():
@@ -355,6 +360,11 @@ class NodeMemoryPool:
                 self.revocations += 1
                 self.reserved = max(0, self.reserved - freed)
                 self._cond.notify_all()
+        if freed:
+            _fr.record(
+                "memory_revoke", node=self.name, query_id=query_id,
+                freed_bytes=freed, leases=len(hooks),
+            )
         for hook in hooks:  # outside the lock: hooks touch task state
             try:
                 hook()

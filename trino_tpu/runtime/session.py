@@ -354,6 +354,69 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 0,
         ),
         _Prop(
+            "anomaly_detection_enabled", bool, True,
+            "anomaly sentinel (runtime/history.py baselines): on query "
+            "finish the coordinator scores the run against its planhash's "
+            "rolling baseline and attaches typed anomalies "
+            "(SLOW_VS_BASELINE, SPILL_REGRESSION, RETRY_STORM, "
+            "COMPILE_STORM) to QueryInfo / history / the EXPLAIN ANALYZE "
+            "footer; anomalous runs auto-trigger a post-mortem bundle",
+            None,
+        ),
+        _Prop(
+            "anomaly_min_samples", int, 3,
+            "clean baseline runs required per planhash before the sentinel "
+            "scores at all — below it the sentinel stays silent (cold "
+            "start must not false-positive)",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "anomaly_slow_factor", float, 2.0,
+            "SLOW_VS_BASELINE fires when wall > max(baseline p95, "
+            "factor x baseline p50) and the absolute delta clears "
+            "anomaly_min_wall_delta_ms",
+            lambda v: v >= 1.0,
+        ),
+        _Prop(
+            "anomaly_min_wall_delta_ms", float, 50.0,
+            "absolute wall-clock floor for SLOW_VS_BASELINE — sub-floor "
+            "jitter on fast queries never flags",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "anomaly_spill_min_ms", float, 100.0,
+            "SPILL_REGRESSION floor: spill time must exceed this AND "
+            "anomaly_slow_factor x the baseline's spill p50",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "anomaly_retry_storm_threshold", int, 3,
+            "RETRY_STORM fires at this many task retries in one run when "
+            "the baseline's retry p50 is below it",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "anomaly_compile_storm_min", int, 2,
+            "COMPILE_STORM fires when a run's compile count exceeds "
+            "max(2 x baseline p50, baseline p50 + this)",
+            lambda v: v >= 1,
+        ),
+        _Prop(
+            "postmortem_enabled", bool, True,
+            "write a cross-node post-mortem bundle (flight-recorder "
+            "slices + phase ledger + journal records + final QueryInfo) "
+            "under the spool dir on typed query failure or anomaly; "
+            "served by GET /v1/query/{id}/postmortem and renderable via "
+            "scripts/postmortem_report.py",
+            None,
+        ),
+        _Prop(
+            "postmortem_budget_bytes", int, 16 << 20,
+            "disk-pool lease size cap for one post-mortem bundle; bundles "
+            "larger than this are truncated (oldest events dropped)",
+            lambda v: v >= 1 << 10,
+        ),
+        _Prop(
             "execute_batch_window_ms", float, 0.0,
             "shared small-query batching: concurrent EXECUTEs of the SAME "
             "prepared plan arriving within this window are stacked into "

@@ -35,6 +35,7 @@ from typing import Callable, Optional, Sequence
 
 from ..plan.nodes import TableScan, walk
 from ..plan.stats import estimate, scan_rows
+from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
 
 __all__ = ["SplitScheduler", "scan_split_plan", "current_backlog"]
@@ -166,10 +167,16 @@ class SplitScheduler:
         nsplits: int,
         queue_depth: int = 2,
         is_parked: Optional[Callable[[str], bool]] = None,
+        query_id: str = "",
+        node: str = "",
     ):
         self.nsplits = int(nsplits)
         self.queue_depth = max(1, int(queue_depth))
         self._is_parked = is_parked or (lambda url: False)
+        # flight-recorder attribution: the owning query and the
+        # coordinator node this scheduler runs on (utils/flightrecorder.py)
+        self.query_id = query_id
+        self.node = node
         self._lock = threading.Lock()
         self._pool: deque[int] = deque()
         self._inflight: dict[int, str] = {}  # part -> worker url
@@ -243,8 +250,21 @@ class SplitScheduler:
                 # whole-task re-slice
                 self.stats["parked"] += 1
                 SPLITS_TOTAL.labels("parked").inc()
-        for _ in out:
+                _fr.record(
+                    "split_park",
+                    node=self.node,
+                    query_id=self.query_id or None,
+                    queued=len(self._pool),
+                )
+        for p, w in out:
             SPLITS_TOTAL.labels("assigned").inc()
+            _fr.record(
+                "split_assign",
+                node=self.node,
+                query_id=self.query_id or None,
+                split=p,
+                worker=w,
+            )
         _backlog_add(-len(out))
         return out
 
@@ -285,6 +305,14 @@ class SplitScheduler:
             self.stats["retries"] += 1
         SPLIT_RETRIES.inc()
         SPLITS_TOTAL.labels("retried").inc()
+        _fr.record(
+            "split_retry",
+            node=self.node,
+            query_id=self.query_id or None,
+            split=part,
+            worker=w,
+            excluded=exclude,
+        )
         return w
 
     def steal(
@@ -326,6 +354,14 @@ class SplitScheduler:
                 self.stats["steals"] += 1
                 SPLIT_STEALS.inc()
                 SPLITS_TOTAL.labels("stolen").inc()
+                _fr.record(
+                    "split_steal",
+                    node=self.node,
+                    query_id=self.query_id or None,
+                    split=p,
+                    thief=thief,
+                    victim=self._inflight.get(p),
+                )
                 return p, thief
             return None
 

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Iterable, Optional
 
+from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
 from .disk import guarded_write
 
@@ -184,11 +185,19 @@ class SpooledExchange:
             raise
         try:
             os.rename(tmp, tdir)  # atomic publish; fails if the target exists
+            _fr.record(
+                "spool_commit", node=SPOOL_URL, task_id=task_id,
+                attempt=attempt, won=True,
+            )
             return True
         except OSError:
             shutil.rmtree(tmp, ignore_errors=True)
             if lease is not None:
                 lease.release()  # the winning attempt holds the bytes
+            _fr.record(
+                "spool_commit", node=SPOOL_URL, task_id=task_id,
+                attempt=attempt, won=False,
+            )
             return False
 
     # ------------------------------------------------------------- consumer
@@ -361,6 +370,11 @@ class SpooledExchange:
             shutil.rmtree(path, ignore_errors=True)
             freed += nbytes
             _SPOOL_RECLAIM.labels("memo" if rank == 0 else "nonlive").inc()
+            _fr.record(
+                "spool_reclaim", node=SPOOL_URL, task_id=name,
+                category="memo" if rank == 0 else "nonlive",
+                freed_bytes=nbytes,
+            )
         return freed
 
 

@@ -64,6 +64,7 @@ from ..connectors.spi import CatalogManager
 from ..data.page import Page
 from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
+from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env
 from .disk import DiskExceeded, NodeDiskPool, guarded_write
@@ -611,6 +612,10 @@ class Worker:
         # join the coordinator's trace: the task span (and any children)
         # shares the query's trace_id (W3C traceparent, utils/tracing.py)
         self.tracer.join(req.get("traceparent"))
+        _fr.record(
+            "task_start", node=self.url, query_id=task.query_id,
+            task_id=task.task_id,
+        )
         try:
             with self.tracer.span(
                 "task", task_id=task.task_id, query_id=task.query_id or "",
@@ -621,6 +626,11 @@ class Worker:
             # a late successful run must not count (or report) as finished
             if task.state == "FINISHED":
                 self._m_tasks.labels("finished").inc()
+            _fr.record(
+                "task_finish", node=self.url, query_id=task.query_id,
+                task_id=task.task_id, state=task.state,
+                wall_ms=round((_time.perf_counter() - t0) * 1e3, 1),
+            )
         except Exception as e:
             if not task.canceled:  # canceled attempts fail by design
                 traceback.print_exc()
@@ -631,6 +641,11 @@ class Worker:
                 }
             task.fail(str(e))
             self._m_tasks.labels("failed").inc()
+            _fr.record(
+                "task_fail", node=self.url, query_id=task.query_id,
+                task_id=task.task_id, error=str(e)[:200],
+                canceled=bool(task.canceled),
+            )
         finally:
             if task.mem_lease is not None:
                 task.mem_lease.release()  # idempotent with delete_task
@@ -654,15 +669,40 @@ class Worker:
         if self.memory_pool is not None and reserve_bytes:
             timeout_s = req.get("memory_blocked_timeout_s")
             t_r0 = _time.perf_counter()
+
+            # flight-recorder lane attribution rides the existing memory
+            # hooks: park/unpark/revoke are the events a post-mortem needs
+            # to explain a task that sat BLOCKED or degraded to spill
+            def _on_block() -> None:
+                task.set_blocked(True)
+                _fr.record(
+                    "task_park", node=self.url, query_id=task.query_id,
+                    task_id=task.task_id, bytes=reserve_bytes,
+                )
+
+            def _on_unblock() -> None:
+                task.set_blocked(False)
+                _fr.record(
+                    "task_unpark", node=self.url, query_id=task.query_id,
+                    task_id=task.task_id,
+                )
+
+            def _on_revoke() -> None:
+                task.revoke_requested = True
+                _fr.record(
+                    "task_revoke", node=self.url, query_id=task.query_id,
+                    task_id=task.task_id,
+                )
+
             task.mem_lease = self.memory_pool.reserve(
                 task.query_id or task.task_id,
                 reserve_bytes,
                 revocable=_fragment_revocable(fragment),
                 timeout_s=float(timeout_s) if timeout_s else None,
                 what=f"task {task.task_id} reservation",
-                on_block=lambda: task.set_blocked(True),
-                on_unblock=lambda: task.set_blocked(False),
-                on_revoke=lambda: setattr(task, "revoke_requested", True),
+                on_block=_on_block,
+                on_unblock=_on_unblock,
+                on_revoke=_on_revoke,
                 abort=lambda: task.canceled,
             )
             mem_blocked_ms = (_time.perf_counter() - t_r0) * 1e3
@@ -752,7 +792,9 @@ class Worker:
                             SpooledExchange(req["exchange_dir"]).discard(t)
                         try:
                             blobs.extend(
-                                _stream_fetch(u, t, buffer_id, ack=ack)
+                                _stream_fetch(
+                                    u, t, buffer_id, ack=ack, node=self.url
+                                )
                             )
                         except RuntimeError as e:
                             if "spooled chunk removed" in str(e):
@@ -1046,6 +1088,13 @@ class Worker:
                 )
             return st
 
+    def flightrecorder_nodes(self) -> list[str]:
+        """This worker's flight-recorder `node` aliases: its URL (task and
+        exchange events) and its pool name (memory/disk lease events).  The
+        /v1/flightrecorder endpoint filters on these so in-process test
+        clusters sharing one ring still serve disjoint per-node lanes."""
+        return [self.url, f"worker:{self.port}"]
+
     def metrics_text(self) -> str:
         """Prometheus exposition for this worker + the process-global
         registry (spill, caches, SPMD exchange planning)."""
@@ -1120,6 +1169,7 @@ def _stream_fetch(
     buffer_id: int,
     ack: bool = True,
     backoff: Optional[Backoff] = None,
+    node: str = "",
 ) -> list[bytes]:
     """Token-sequenced consumption of one producer buffer with acknowledge —
     the reference's HttpPageBufferClient loop (sendGetResults:355, token+ack
@@ -1145,6 +1195,10 @@ def _stream_fetch(
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             if e.code in (502, 503, 504):  # transient: retry same token
+                _fr.record(
+                    "exchange_retry", node=node, task_id=task_id,
+                    producer=worker_url, token=token, http=e.code,
+                )
                 if backoff.failure():
                     raise RuntimeError(
                         f"fetch {task_id}/{buffer_id}/{token} from "
@@ -1159,7 +1213,11 @@ def _stream_fetch(
                 f"fetch {task_id}/{buffer_id}/{token} from {worker_url}: "
                 f"HTTP {e.code}: {detail}"
             )
-        except Exception:
+        except Exception as e:
+            _fr.record(
+                "exchange_retry", node=node, task_id=task_id,
+                producer=worker_url, token=token, error=str(e)[:120],
+            )
             if backoff.failure():
                 raise
             backoff.sleep()
@@ -1188,10 +1246,16 @@ def _stream_fetch(
                     f"{worker_url}/v1/task/{task_id}/results/{buffer_id}/{token}/acknowledge"
                 )
             if complete:
-                return blobs
+                break
         elif complete:
-            return blobs
+            break
         # else: no data yet — long-poll again
+    _fr.record(
+        "exchange_fetch", node=node, task_id=task_id, producer=worker_url,
+        buffer=buffer_id, chunks=len(blobs),
+        bytes=sum(len(b) for b in blobs),
+    )
+    return blobs
 
 
 def _quiet_get(url: str) -> None:
@@ -1228,6 +1292,25 @@ def _make_handler(worker: Worker):
                     worker.metrics_text().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            # GET /v1/flightrecorder?query_id=&all= — this node's lane of
+            # the process-global flight recorder (utils/flightrecorder.py);
+            # the coordinator's post-mortem fan-out reads it per worker
+            if parts == ["v1", "flightrecorder"]:
+                nodes = (
+                    None if params.get("all")
+                    else worker.flightrecorder_nodes()
+                )
+                events = _fr.snapshot(
+                    query_id=params.get("query_id") or None, nodes=nodes
+                )
+                body = json.dumps(
+                    {
+                        "node": worker.url,
+                        "stats": _fr.stats(),
+                        "events": events,
+                    }
+                ).encode()
+                return self._send(200, body, "application/json")
             if parts[:2] == ["v1", "info"]:
                 import resource as _res
 

@@ -22,7 +22,11 @@ def main(argv=None) -> int:
     ap.add_argument("--default-catalog", default=None)
     args = ap.parse_args(argv)
 
-    from .runtime.config import load_catalogs, load_node_config
+    from .runtime.config import (
+        apply_flightrecorder_config,
+        load_catalogs,
+        load_node_config,
+    )
     from .utils.compilecache import enable_persistent_cache
 
     # host-keyed on-disk XLA cache: a restarted (or newly launched) node
@@ -30,6 +34,7 @@ def main(argv=None) -> int:
     enable_persistent_cache()
 
     cfg = load_node_config(args.etc)
+    apply_flightrecorder_config(cfg)
     catalogs = load_catalogs(args.etc)
     names = catalogs.names()
     default_catalog = args.default_catalog or (names[0] if names else "memory")
