@@ -50,6 +50,16 @@
 #           (spool-committed stages re-read, zero recompute) and the
 #           client rides through the router with zero visible failures;
 #           plus lease lifecycle, GC mutual exclusion, shard stability
+# Write-plane chaos (tests/test_write_txn.py):
+#   write   COMMIT_CRASH at every phase boundary of the staged-commit
+#           protocol (intent / commit / ack) — the target table must be
+#           byte-identical to the pre-image XOR the post-image, never
+#           torn; restart replays uncommitted intents to a clean abort
+#           with staging reclaimed and committed-unacked txns as a
+#           no-op (exactly-once via the journal commit marker); plus
+#           the two-writer snapshot-CAS conflict drills, the DISK_FULL
+#           staging abort, the janitor reclaim sweep, and the fleet
+#           adoption commit-marker guard
 # No subcommand runs the full seeded chaos schedule suite (-m chaos).
 #
 # Not part of the tier-1 gate (marked slow); run it before touching the
@@ -103,6 +113,12 @@ case "${1:-}" in
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
         -p no:cacheprovider "$@"
+    ;;
+  write)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_write_txn.py \
+        "tests/test_fleet.py::test_adoption_consults_commit_marker_never_double_applies" \
+        -q -p no:cacheprovider "$@"
     ;;
   postmortem)
     shift

@@ -13,7 +13,10 @@
 # nonzero trino_tpu_flightrecorder_events_total, GET /v1/flightrecorder
 # on both node roles, a seeded SLOW re-run carrying the `-- anomaly:`
 # EXPLAIN ANALYZE footer, and the auto + on-demand post-mortem bundle
-# round-trip over GET/POST /v1/query/{id}/postmortem.
+# round-trip over GET/POST /v1/query/{id}/postmortem, and the
+# transactional write plane — a DML through the staged-commit protocol
+# must carry the `-- txn:` footer and a nonzero
+# trino_tpu_write_txn_total{outcome="committed"} counter.
 #
 # Fast enough to run on every runtime/ or exec/ change; the same checks
 # run under the tier-1 gate via tests/test_obs_plane.py.
@@ -411,6 +414,27 @@ try:
     assert "origin" in ui, "/ui missing the fleet origin column"
     print(f"fleet /v1/info + /ui: "
           f"{len(sinfo['fleet']['members'])} members listed ok")
+
+    # transactional write plane (runtime/txn.py): a DML through the
+    # staged-commit protocol must carry the `-- txn:` EXPLAIN ANALYZE
+    # footer and bump trino_tpu_write_txn_total{outcome="committed"}
+    wrows = survivor.execute_query(
+        "explain analyze insert into build select k + 1000, w from build")
+    wtext = "\n".join(row[0] for row in wrows)
+    wlines = [ln for ln in wtext.splitlines() if ln.startswith("-- txn:")]
+    assert wlines and "outcome=committed" in wlines[0], (
+        f"expected a committed txn footer:\n{wtext[-600:]}"
+    )
+    print(f"write txn: {wlines[0]}")
+    wmtext = get(survivor.url + "/metrics")
+    wc = [
+        ln for ln in wmtext.splitlines()
+        if ln.startswith('trino_tpu_write_txn_total{outcome="committed"}')
+    ]
+    assert wc and float(wc[0].split()[-1]) > 0, (
+        f"expected a nonzero committed write-txn counter: {wc}"
+    )
+    print(f"write txn committed counter: {wc[0].split()[-1]}")
     print("OBS_SMOKE_OK")
 finally:
     conn.gate.set()

@@ -584,3 +584,64 @@ def test_router_injects_retry_after_on_bare_shed(code):
             router.stop()
     srv.shutdown()
     srv2.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_adoption_consults_commit_marker_never_double_applies(tmp_path):
+    """Write-plane adoption guard (runtime/txn.py): a peer adopting a dead
+    member's journaled in-flight INSERT must consult the commit marker
+    before RESUME.  The dead member committed but never acked — the
+    adopter replays the write as a NO-OP, and the row-count oracle proves
+    the insert applied exactly once across the whole failover."""
+    from trino_tpu.runtime.txn import TXN_TOTAL
+
+    conn = MemoryConnector()
+    conn.create_table(
+        "t", [ColumnSchema("k", BIGINT), ColumnSchema("v", BIGINT)]
+    )
+    conn.insert("t", {"k": np.arange(6, dtype=np.int64),
+                      "v": np.arange(6, dtype=np.int64) * 10})
+    runner = _fleet_cluster(conn, str(tmp_path / "spool"))
+    try:
+        c0 = runner.coordinators[0]
+        noop0 = TXN_TOTAL.value("replayed_noop")
+        # crash c0 at the committed-unacked boundary of the INSERT
+        runner.inject_write_failure(phase="ack", coordinator_index=0)
+
+        def _go():
+            try:
+                c0.execute_query("insert into t select k + 100, v from t")
+            except Exception:
+                pass  # the dying coordinator returns nothing useful
+
+        threading.Thread(target=_go, daemon=True).start()
+        assert _wait(lambda: c0._killed), "COMMIT_CRASH never fired"
+        # oracle BEFORE adoption: the connector commit landed (6 -> 12)
+        assert _wait(lambda: conn.estimated_row_count("t") == 12)
+        # the survivor adopts off c0's expired lease and replays the
+        # intent against the commit marker — a re-execution would land a
+        # THIRD copy of the rows
+        c1 = runner.coordinators[1]
+        assert _wait(
+            lambda: TXN_TOTAL.value("replayed_noop") == noop0 + 1,
+            timeout=30,
+        ), "adopter never replayed the write as a no-op"
+        adopted = [
+            rec for rec in c1.queries.values() if rec.get("adopted_from")
+        ]
+        assert adopted, "survivor never adopted the peer's query"
+        assert _wait(lambda: adopted[0]["done"].is_set())
+        assert adopted[0]["sm"].state == "FINISHED"
+        assert adopted[0]["result"] == [(6,)]
+        # oracle AFTER adoption: exactly-once — still 12, never 18
+        assert conn.estimated_row_count("t") == 12
+        # the adopter re-journaled the peer's marker: a second failover
+        # would ALSO no-op off the adopter's own journal
+        adopted_jq = QueryJournal.replay(c1.fleet.journal_path_for())
+        qid = next(iter(
+            q for q in adopted_jq.values() if q.write_commits
+        ))
+        assert qid.write_commits and qid.state == "FINISHED"
+    finally:
+        runner.stop()

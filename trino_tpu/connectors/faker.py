@@ -109,3 +109,8 @@ class FakerConnector(Connector):
     # ---- writes (INSERT appends are meaningless for generated data) -------
     def insert(self, table: str, columns: dict) -> int:
         raise NotImplementedError("faker tables are generated, not written")
+
+    def begin_write(self, table: str, txn_id: str, operation: str):
+        # reject before the txn layer journals an intent: there is nothing
+        # to stage, abort, or janitor-sweep for generated data
+        raise NotImplementedError("faker tables are generated, not written")

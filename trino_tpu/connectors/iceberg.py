@@ -37,7 +37,10 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..data.types import Type, parse_type
-from .spi import ColumnSchema, ColumnStats, Connector, Split, TableSchema, TableStats
+from .spi import (
+    ColumnSchema, ColumnStats, Connector, Split, StagedWrite, TableSchema,
+    TableStats, staged_nbytes,
+)
 
 __all__ = ["IcebergConnector"]
 
@@ -47,6 +50,28 @@ def _pa():
     import pyarrow.parquet  # noqa: F401
 
     return pyarrow
+
+
+class _IcebergStagedWrite(StagedWrite):
+    """Stages immutable data files as data/stg-<txn>-<uuid>.parquet: on disk
+    immediately (so a crashed writer's staging is durable for the janitor to
+    find and reclaim) but invisible to every reader until a committed
+    snapshot's manifest names them — Iceberg's core trick."""
+
+    def __init__(self, conn, table, txn_id, operation, expected_version):
+        super().__init__(conn, table, txn_id, operation, expected_version)
+        self.staged_files: list[dict] = []  # manifest entries (stg- paths)
+
+    def stage_insert(self, data: dict) -> None:
+        nbytes = staged_nbytes(data)
+        pool = getattr(self.conn, "disk_pool", None)
+        if pool is not None and nbytes:
+            self.leases.append(pool.reserve(
+                owner=f"txn:{self.txn_id}", nbytes=nbytes,
+                timeout_s=getattr(self.conn, "write_stage_timeout_s", 10.0),
+                what="write-stage"))
+        self.staged_files.append(self.conn._write_staged_file(self, data))
+        self.staged_bytes += nbytes
 
 
 class IcebergConnector(Connector):
@@ -342,20 +367,7 @@ class IcebergConnector(Connector):
             t = pa.table(arrays)
             rel = os.path.join("data", f"{uuid.uuid4().hex}.parquet")
             pq.write_table(t, os.path.join(self.warehouse, table, rel))
-            stats = {}
-            for cs in schema.columns:
-                arr = cols[cs.name]
-                base_arr = (
-                    np.ma.getdata(arr)[~np.ma.getmaskarray(arr)]
-                    if isinstance(arr, np.ma.MaskedArray)
-                    else np.asarray(arr)
-                )
-                if (
-                    len(base_arr)
-                    and base_arr.dtype != object
-                    and np.issubdtype(base_arr.dtype, np.number)
-                ):
-                    stats[cs.name] = [float(base_arr.min()), float(base_arr.max())]
+            stats = self._file_stats(schema, cols)
             manifest.append({"path": rel, "rows": t.num_rows, "stats": stats})
             written += t.num_rows
         sid = max(s["snapshot_id"] for s in meta["snapshots"]) + 1
@@ -371,3 +383,197 @@ class IcebergConnector(Connector):
         )
         self._commit(table, meta)
         return written
+
+    @staticmethod
+    def _file_stats(schema: TableSchema, cols: dict) -> dict:
+        """Per-column min/max manifest stats (the Iceberg pruning bounds)."""
+        stats = {}
+        for cs in schema.columns:
+            arr = cols[cs.name]
+            base_arr = (
+                np.ma.getdata(arr)[~np.ma.getmaskarray(arr)]
+                if isinstance(arr, np.ma.MaskedArray)
+                else np.asarray(arr)
+            )
+            if (
+                len(base_arr)
+                and base_arr.dtype != object
+                and np.issubdtype(base_arr.dtype, np.number)
+            ):
+                stats[cs.name] = [float(base_arr.min()), float(base_arr.max())]
+        return stats
+
+    # ----------------------------------------------- transactional write SPI
+    # The staged-file suffix is a fixed-width uuid4 hex + ".parquet", so the
+    # owning txn id parses back out of any stg- filename unambiguously even
+    # though txn ids themselves contain dashes.
+    _STG_TAIL = 32 + 1 + len(".parquet")
+
+    def _staged_schema(self, handle) -> TableSchema:
+        if handle.creates:
+            _, columns = handle.creates[-1]
+            return TableSchema(handle.table, tuple(columns))
+        return self.table_schema(handle.table)
+
+    def _write_staged_file(self, handle, cols: dict) -> dict:
+        pa = _pa()
+        import pyarrow.parquet as pq
+
+        from .parquet import _numpy_to_arrow
+
+        schema = self._staged_schema(handle)
+        os.makedirs(self._data_dir(handle.table), exist_ok=True)
+        arrays = {
+            cs.name: _numpy_to_arrow(cols[cs.name], cs.type)
+            for cs in schema.columns
+        }
+        t = pa.table(arrays)
+        rel = os.path.join(
+            "data", f"stg-{handle.txn_id}-{uuid.uuid4().hex}.parquet"
+        )
+        pq.write_table(t, os.path.join(self.warehouse, handle.table, rel))
+        return {
+            "path": rel,
+            "rows": t.num_rows,
+            "stats": self._file_stats(schema, cols),
+        }
+
+    def write_version(self, table: str):
+        """CAS token = the table's current snapshot id (None for a table
+        that doesn't exist yet, i.e. CTAS) — per-table, so writers to
+        different tables never conflict."""
+        try:
+            return self._load_meta(table)["current_snapshot_id"]
+        except (KeyError, OSError, ValueError):
+            return None
+
+    def begin_write(self, table: str, txn_id: str, operation: str):
+        state = self._write_state()
+        handle = _IcebergStagedWrite(
+            self, table, txn_id, operation, self.write_version(table)
+        )
+        with state["lock"]:
+            state["staged"][txn_id] = handle
+        return handle
+
+    def _apply_staged(self, handle) -> int:
+        """Commit = promote staged files into a new snapshot's manifest and
+        advance the metadata pointer — one `_commit` (tmp+rename of the
+        version hint) is the atomic point, exactly like any other Iceberg
+        commit.  The snapshot is stamped with the txn id: that stamp IS the
+        durable commit marker `txn_committed` probes during replay."""
+        for name, columns in handle.creates:
+            self.create_table(name, columns)
+        meta = self._load_meta(handle.table)
+        cur = self._snapshot(handle.table, None)
+        manifest = (
+            [] if (handle.replace or handle.creates) else list(cur["manifest"])
+        )
+        rows = 0
+        for entry in handle.staged_files:
+            # promote: rename out of the stg- namespace so the janitor's
+            # orphan sweep can never match a committed data file
+            final_rel = os.path.join("data", f"{uuid.uuid4().hex}.parquet")
+            os.replace(
+                os.path.join(self.warehouse, handle.table, entry["path"]),
+                os.path.join(self.warehouse, handle.table, final_rel),
+            )
+            manifest.append(
+                {"path": final_rel, "rows": entry["rows"],
+                 "stats": entry["stats"]}
+            )
+            rows += entry["rows"]
+        sid = max(s["snapshot_id"] for s in meta["snapshots"]) + 1
+        meta["version"] += 1
+        meta["current_snapshot_id"] = sid
+        meta["snapshots"].append(
+            {
+                "snapshot_id": sid,
+                "timestamp_ms": int(time.time() * 1000),
+                "operation": handle.operation,
+                "manifest": manifest,
+                "txn_id": handle.txn_id,
+                "txn_rows": rows,
+            }
+        )
+        self._commit(handle.table, meta)
+        handle.staged_files = []
+        return rows
+
+    def _discard_staged(self, handle) -> None:
+        for entry in getattr(handle, "staged_files", []):
+            try:
+                os.remove(
+                    os.path.join(self.warehouse, handle.table, entry["path"])
+                )
+            except OSError:
+                pass
+        handle.staged_files = []
+        super()._discard_staged(handle)
+
+    def txn_committed(self, table: str, txn_id: str):
+        rows = super().txn_committed(table, txn_id)
+        if rows is not None:
+            return rows
+        # durable probe: the committing snapshot carries its txn id, so the
+        # marker survives process death (unlike the in-memory registry)
+        try:
+            meta = self._load_meta(table)
+        except (KeyError, OSError, ValueError):
+            return None
+        for s in meta["snapshots"]:
+            if s.get("txn_id") == txn_id:
+                return int(s.get("txn_rows") or 0)
+        return None
+
+    def _staged_data_dirs(self):
+        """Data dirs of every table dir in the warehouse — including half-
+        born CTAS targets that have staged files but no metadata yet."""
+        try:
+            names = os.listdir(self.warehouse)
+        except OSError:
+            return
+        for name in names:
+            if name == ".dropped":
+                continue
+            dd = os.path.join(self.warehouse, name, "data")
+            if os.path.isdir(dd):
+                yield dd
+
+    def orphaned_staging(self) -> dict:
+        out = super().orphaned_staging()
+        now = time.time()
+        for dd in self._staged_data_dirs():
+            try:
+                names = os.listdir(dd)
+            except OSError:
+                continue
+            for n in names:
+                if not n.startswith("stg-") or len(n) <= 4 + self._STG_TAIL:
+                    continue
+                txn = n[4:-self._STG_TAIL]
+                if txn in out:
+                    continue
+                try:
+                    out[txn] = now - os.path.getmtime(os.path.join(dd, n))
+                except OSError:
+                    continue
+        return out
+
+    def reclaim_staging(self, txn_id: str) -> int:
+        freed = super().reclaim_staging(txn_id)
+        for dd in self._staged_data_dirs():
+            try:
+                names = os.listdir(dd)
+            except OSError:
+                continue
+            for n in names:
+                if not n.startswith(f"stg-{txn_id}-"):
+                    continue
+                p = os.path.join(dd, n)
+                try:
+                    freed += os.path.getsize(p)
+                    os.remove(p)
+                except OSError:
+                    pass
+        return freed

@@ -146,6 +146,41 @@ class MemoryConnector(Connector):
         self.generation += 1
         return n
 
+    def _apply_staged(self, handle) -> int:
+        """Staged-swap commit: the post-image is assembled off to the side
+        and swapped into `_data[table]` in ONE dict assignment, so a
+        concurrent read_split never observes the empty window the default
+        truncate-then-insert sequence would expose."""
+        rows = 0
+        for name, columns in handle.creates:
+            self.create_table(name, columns)
+        table = handle.table
+        schema = self.table_schema(table)
+        if handle.replace:
+            new = {
+                c.name: np.empty((0,), dtype=object if c.type.is_string
+                                 else c.type.np_dtype)
+                for c in schema.columns
+            }
+        else:
+            new = dict(self._data[table])
+        for batch in handle.inserts:
+            rows += len(next(iter(batch.values()))) if batch else 0
+            for c in schema.columns:
+                arr = batch[c.name]
+                old = new[c.name]
+                if isinstance(arr, np.ma.MaskedArray) or isinstance(
+                    old, np.ma.MaskedArray
+                ):
+                    new[c.name] = np.ma.concatenate([old, arr])
+                else:
+                    new[c.name] = np.concatenate([old, arr])
+        self._data[table] = new  # the atomic point for readers
+        self.generation += 1
+        if handle.replace and not handle.inserts:
+            rows = 0
+        return rows
+
     def estimated_row_count(self, table: str) -> Optional[int]:
         data = self._data.get(table)
         if not data:

@@ -19,7 +19,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .parquet import _arrow_to_type, _column_to_numpy, _numpy_to_arrow
+from .parquet import (
+    _FileWriteTxnMixin, _arrow_to_type, _column_to_numpy, _numpy_to_arrow,
+)
 from .spi import ColumnSchema, Connector, Split, TableSchema
 
 __all__ = ["OrcConnector"]
@@ -37,8 +39,9 @@ class _StripeGroup:
     stripes: tuple[int, ...]
 
 
-class OrcConnector(Connector):
+class OrcConnector(_FileWriteTxnMixin, Connector):
     name = "orc"
+    _EXT = ".orc"
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
@@ -163,6 +166,29 @@ class OrcConnector(Connector):
         orc.write_table(t, os.path.join(dirp, f"part-{part}.orc"))
         self._invalidate(table)
         return t.num_rows
+
+    def _write_part_file(self, path: str, schema: TableSchema, cols: dict) -> int:
+        import pyarrow as pa
+
+        orc = _orc()
+        arrays = {
+            cs.name: _numpy_to_arrow(cols[cs.name], cs.type)
+            for cs in schema.columns
+        }
+        t = pa.table(arrays)
+        orc.write_table(t, path)
+        return t.num_rows
+
+    def truncate(self, table: str) -> None:
+        schema = self._schema_cache.get(table) or self.table_schema(table)
+        dirp = os.path.join(self.root, table)
+        if os.path.isdir(dirp):
+            for f in os.listdir(dirp):
+                if f.endswith(self._EXT):
+                    os.remove(os.path.join(dirp, f))
+        self._declared[table] = schema
+        self._schema_cache[table] = schema
+        self._invalidate(table)
 
     def _invalidate(self, table: str) -> None:
         self.generation += 1

@@ -20,6 +20,8 @@ file); a single file is a table too.  Writes (CTAS) emit one file per task.
 from __future__ import annotations
 
 import os
+import shutil
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -29,7 +31,7 @@ from ..data.types import (
     BIGINT, BOOLEAN, DATE, DOUBLE, DecimalType, INTEGER, REAL, SMALLINT,
     TIMESTAMP, TINYINT, Type, VARCHAR,
 )
-from .spi import ColumnSchema, Connector, Split, TableSchema
+from .spi import ColumnSchema, Connector, Split, StagedWrite, TableSchema, staged_nbytes
 
 __all__ = ["ParquetConnector"]
 
@@ -122,7 +124,165 @@ class _FileGroup:
     rg_count: int
 
 
-class ParquetConnector(Connector):
+class _FileStagedWrite(StagedWrite):
+    """Staged write for file-per-part connectors (parquet, orc): parts land
+    under `<table>/.staging/<txn_id>/` — durable on disk for crash-orphan
+    reclaim, invisible to `_table_files` (which only matches the table dir
+    itself) — and commit moves them in under txn-tagged names, fixing the
+    `part-{count}` clobber hazard along the way."""
+
+    def __init__(self, conn, table, txn_id, operation, expected_version):
+        super().__init__(conn, table, txn_id, operation, expected_version)
+        self.staged_parts: list[tuple[str, int]] = []  # (abs path, rows)
+
+    def stage_insert(self, data: dict) -> None:
+        nbytes = staged_nbytes(data)
+        pool = getattr(self.conn, "disk_pool", None)
+        if pool is not None and nbytes:
+            self.leases.append(pool.reserve(
+                owner=f"txn:{self.txn_id}", nbytes=nbytes,
+                timeout_s=getattr(self.conn, "write_stage_timeout_s", 10.0),
+                what="write-stage"))
+        self.staged_parts.append(self.conn._write_staged_part(self, data))
+        self.staged_bytes += nbytes
+
+
+class _FileWriteTxnMixin:
+    """Transactional write SPI shared by ParquetConnector and OrcConnector.
+
+    Commit marker: `<table>/.txn/<txn_id>` holding the applied row count —
+    written immediately after the staged parts move in, so `txn_committed`
+    survives process death.  (The move-then-marker pair is two steps, not
+    one rename — the window is documented in the README failure table; the
+    iceberg connector is the connector with a true single-pointer commit.)
+    """
+
+    _EXT = ".parquet"
+
+    def _staging_dir(self, table: str, txn_id: str) -> str:
+        return os.path.join(self.root, table, ".staging", txn_id)
+
+    def _marker_path(self, table: str, txn_id: str) -> str:
+        return os.path.join(self.root, table, ".txn", txn_id)
+
+    def begin_write(self, table: str, txn_id: str, operation: str):
+        state = self._write_state()
+        handle = _FileStagedWrite(
+            self, table, txn_id, operation, self.write_version(table)
+        )
+        with state["lock"]:
+            state["staged"][txn_id] = handle
+        return handle
+
+    def _write_staged_part(self, handle, cols: dict) -> tuple[str, int]:
+        schema = (
+            TableSchema(handle.table, tuple(handle.creates[-1][1]))
+            if handle.creates
+            else (self._schema_cache.get(handle.table)
+                  or self.table_schema(handle.table))
+        )
+        sd = self._staging_dir(handle.table, handle.txn_id)
+        os.makedirs(sd, exist_ok=True)
+        path = os.path.join(
+            sd, f"part-{len(handle.staged_parts)}{self._EXT}"
+        )
+        rows = self._write_part_file(path, schema, cols)
+        return path, rows
+
+    def _apply_staged(self, handle) -> int:
+        for name, columns in handle.creates:
+            self.create_table(name, columns)
+        dirp = os.path.join(self.root, handle.table)
+        os.makedirs(dirp, exist_ok=True)
+        if handle.replace:
+            for f in os.listdir(dirp):
+                if f.endswith(self._EXT):
+                    try:
+                        os.remove(os.path.join(dirp, f))
+                    except OSError:
+                        pass
+        rows = 0
+        for i, (path, n) in enumerate(handle.staged_parts):
+            os.replace(
+                path,
+                os.path.join(dirp, f"part-{handle.txn_id}-{i}{self._EXT}"),
+            )
+            rows += n
+        td = os.path.join(dirp, ".txn")
+        os.makedirs(td, exist_ok=True)
+        tmp = self._marker_path(handle.table, handle.txn_id) + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(str(rows))
+        os.replace(tmp, self._marker_path(handle.table, handle.txn_id))
+        self._discard_staged(handle)
+        self._invalidate(handle.table)
+        return rows
+
+    def _discard_staged(self, handle) -> None:
+        sd = self._staging_dir(handle.table, handle.txn_id)
+        shutil.rmtree(sd, ignore_errors=True)
+        # prune the empty .staging parent so table dirs stay tidy
+        try:
+            os.rmdir(os.path.dirname(sd))
+        except OSError:
+            pass
+        handle.staged_parts = []
+        handle.inserts = []
+        handle.creates = []
+
+    def txn_committed(self, table: str, txn_id: str):
+        rows = super().txn_committed(table, txn_id)
+        if rows is not None:
+            return rows
+        try:
+            with open(self._marker_path(table, txn_id)) as fh:
+                return int(fh.read().strip() or 0)
+        except (OSError, ValueError):
+            return None
+
+    def _staging_roots(self):
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            sd = os.path.join(self.root, name, ".staging")
+            if os.path.isdir(sd):
+                yield sd
+
+    def orphaned_staging(self) -> dict:
+        out = super().orphaned_staging()
+        now = time.time()
+        for sd in self._staging_roots():
+            for txn in os.listdir(sd):
+                if txn in out:
+                    continue
+                try:
+                    out[txn] = now - os.path.getmtime(os.path.join(sd, txn))
+                except OSError:
+                    continue
+        return out
+
+    def reclaim_staging(self, txn_id: str) -> int:
+        freed = super().reclaim_staging(txn_id)
+        for sd in self._staging_roots():
+            d = os.path.join(sd, txn_id)
+            if not os.path.isdir(d):
+                continue
+            for f in os.listdir(d):
+                try:
+                    freed += os.path.getsize(os.path.join(d, f))
+                except OSError:
+                    pass
+            shutil.rmtree(d, ignore_errors=True)
+            try:
+                os.rmdir(sd)
+            except OSError:
+                pass
+        return freed
+
+
+class ParquetConnector(_FileWriteTxnMixin, Connector):
     """Tables = parquet files/directories under a root directory.
 
     Reference analogues: split-per-row-group enumeration mirrors
@@ -131,6 +291,7 @@ class ParquetConnector(Connector):
     """
 
     name = "parquet"
+    _EXT = ".parquet"
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
@@ -290,6 +451,30 @@ class ParquetConnector(Connector):
         pq.write_table(t, os.path.join(dirp, f"part-{part}.parquet"))
         self._invalidate(table)
         return t.num_rows
+
+    def _write_part_file(self, path: str, schema: TableSchema, cols: dict) -> int:
+        pa = _pa()
+        import pyarrow.parquet as pq
+
+        arrays = {
+            cs.name: _numpy_to_arrow(cols[cs.name], cs.type)
+            for cs in schema.columns
+        }
+        t = pa.table(arrays)
+        pq.write_table(t, path)
+        return t.num_rows
+
+    def truncate(self, table: str) -> None:
+        """Drop all part files, keep the declared schema (DML swap path)."""
+        schema = self._schema_cache.get(table) or self.table_schema(table)
+        dirp = os.path.join(self.root, table)
+        if os.path.isdir(dirp):
+            for f in os.listdir(dirp):
+                if f.endswith(self._EXT):
+                    os.remove(os.path.join(dirp, f))
+        self._declared[table] = schema
+        self._schema_cache[table] = schema
+        self._invalidate(table)
 
     def _invalidate(self, table: str) -> None:
         self.generation += 1

@@ -10,6 +10,8 @@ arrays that the executor uploads to HBM as a Page.
 from __future__ import annotations
 
 import abc
+import threading
+import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -19,8 +21,100 @@ from ..data.types import Type
 
 __all__ = [
     "ColumnSchema", "TableSchema", "Split", "Connector", "CatalogManager",
-    "ColumnStats", "TableStats", "compute_table_stats",
+    "ColumnStats", "TableStats", "compute_table_stats", "StagedWrite",
+    "WriteConflictError", "staged_nbytes",
 ]
+
+
+class WriteConflictError(RuntimeError):
+    """The staged write's expected table version no longer matches at the
+    commit point — another writer committed first.  The transaction layer
+    (runtime/txn.py) arbitrates this into a typed WRITE_CONFLICT with
+    bounded recompute-and-retry."""
+
+    def __init__(self, table: str, expected, found):
+        self.table = table
+        self.expected = expected
+        self.found = found
+        super().__init__(
+            f"write conflict on {table}: expected version {expected!r}, "
+            f"found {found!r}"
+        )
+
+
+def staged_nbytes(columns: dict) -> int:
+    """Approximate host bytes a staged batch holds (object/string lanes
+    estimated by value length — nbytes of an object array is pointer size)."""
+    total = 0
+    for arr in columns.values():
+        a = np.ma.getdata(arr) if isinstance(arr, np.ma.MaskedArray) else arr
+        a = np.asarray(a)
+        if a.dtype == object:
+            total += int(sum(len(str(v)) for v in a.tolist())) + 8 * len(a)
+        else:
+            total += int(a.nbytes)
+    return total
+
+
+# guards lazy creation of per-connector write-transaction state (connectors
+# don't share an __init__ chain, so the staged-write registry is attached on
+# first use)
+_SPI_INIT_LOCK = threading.Lock()
+
+
+class StagedWrite:
+    """A connector-side write transaction handle (reference:
+    spi/connector/ConnectorMetadata.beginInsert / finishInsert).
+
+    All new data accumulates here, invisible to readers, until commit_write
+    swaps it in at a single atomic point guarded by a version CAS.  Staged
+    bytes are leased against the node disk pool when the owning connector
+    exposes one (`conn.disk_pool`), so runaway staging hits the PR 16 disk
+    governor instead of the filesystem.
+    """
+
+    def __init__(self, conn: "Connector", table: str, txn_id: str,
+                 operation: str, expected_version) -> None:
+        self.conn = conn
+        self.table = table
+        self.txn_id = txn_id
+        self.operation = operation  # insert | create | delete | update | merge
+        self.expected_version = expected_version
+        self.created_at = time.time()
+        self.replace = False          # truncate-then-insert (whole-table swap)
+        self.creates: list = []       # [(table_name, [ColumnSchema, ...])]
+        self.inserts: list[dict] = [] # staged column batches, applied in order
+        self.staged_bytes = 0
+        self.leases: list = []
+        self.done = False
+
+    # -- staging --------------------------------------------------------
+    def stage_create(self, columns: Sequence["ColumnSchema"]) -> None:
+        self.creates.append((self.table, list(columns)))
+
+    def stage_truncate(self) -> None:
+        self.replace = True
+
+    def stage_insert(self, data: dict) -> None:
+        nbytes = staged_nbytes(data)
+        pool = getattr(self.conn, "disk_pool", None)
+        if pool is not None and nbytes:
+            self.leases.append(pool.reserve(
+                owner=f"txn:{self.txn_id}", nbytes=nbytes,
+                timeout_s=getattr(self.conn, "write_stage_timeout_s", 10.0),
+                what="write-stage"))
+        self.inserts.append(data)
+        self.staged_bytes += nbytes
+
+    def release_leases(self) -> int:
+        freed = self.staged_bytes
+        for lease in self.leases:
+            try:
+                lease.release()
+            except Exception:
+                pass
+        self.leases = []
+        return freed
 
 
 @dataclass(frozen=True)
@@ -176,6 +270,112 @@ class Connector(abc.ABC):
         """Optional column-level stats (NDV/min/max/null fraction) for the
         cost-based optimizer (reference: ConnectorMetadata.getTableStatistics)."""
         return None
+
+    # -- transactional write SPI ---------------------------------------
+    # Reference: ConnectorMetadata.beginInsert/finishInsert and Iceberg's
+    # commitTransaction.  begin_write stages, commit_write swaps atomically
+    # under a version CAS, abort_write discards.  Connectors override
+    # _apply_staged (the swap) and write_version (the CAS token); the
+    # handle registry / locking / committed-marker bookkeeping is shared.
+
+    def _write_state(self):
+        state = getattr(self, "_txn_state", None)
+        if state is None:
+            with _SPI_INIT_LOCK:
+                state = getattr(self, "_txn_state", None)
+                if state is None:
+                    state = {
+                        "lock": threading.Lock(),
+                        "staged": {},     # txn_id -> StagedWrite
+                        "committed": {},  # txn_id -> applied row count
+                    }
+                    self._txn_state = state
+        return state
+
+    def write_version(self, table: str):
+        """Opaque CAS token for the table's current committed state.  The
+        default is the connector-wide generation counter (coarse: any write
+        conflicts with any other); iceberg narrows it to the per-table
+        snapshot id."""
+        return getattr(self, "generation", 0)
+
+    def begin_write(self, table: str, txn_id: str, operation: str) -> StagedWrite:
+        state = self._write_state()
+        handle = StagedWrite(self, table, txn_id, operation,
+                             self.write_version(table))
+        with state["lock"]:
+            state["staged"][txn_id] = handle
+        return handle
+
+    def commit_write(self, handle: StagedWrite) -> int:
+        """Atomic point: CAS the expected version, apply the staged data,
+        record the commit marker.  Raises WriteConflictError when another
+        writer got there first; the staged data stays intact for retry/abort."""
+        state = self._write_state()
+        with state["lock"]:
+            found = self.write_version(handle.table)
+            if found != handle.expected_version:
+                raise WriteConflictError(handle.table, handle.expected_version, found)
+            rows = self._apply_staged(handle)
+            state["committed"][handle.txn_id] = rows
+            state["staged"].pop(handle.txn_id, None)
+        handle.release_leases()
+        handle.done = True
+        return rows
+
+    def abort_write(self, handle: StagedWrite) -> int:
+        """Discard staged data; the live table was never touched."""
+        state = self._write_state()
+        with state["lock"]:
+            state["staged"].pop(handle.txn_id, None)
+        freed = handle.release_leases()
+        self._discard_staged(handle)
+        handle.done = True
+        return freed
+
+    def _apply_staged(self, handle: StagedWrite) -> int:
+        """Swap staged data into the live table.  Runs under the write lock
+        with the CAS already validated.  Returns rows applied."""
+        rows = 0
+        for name, columns in handle.creates:
+            self.create_table(name, columns)  # type: ignore[attr-defined]
+        if handle.replace and not handle.creates:
+            self.truncate(handle.table)  # type: ignore[attr-defined]
+        for data in handle.inserts:
+            n = self.insert(handle.table, data)  # type: ignore[attr-defined]
+            rows += int(n) if n is not None else (
+                len(next(iter(data.values()))) if data else 0)
+        return rows
+
+    def _discard_staged(self, handle: StagedWrite) -> None:
+        """Connector hook: delete any on-disk staging artifacts."""
+        handle.inserts = []
+        handle.creates = []
+
+    def txn_committed(self, table: str, txn_id: str) -> Optional[int]:
+        """Commit marker probe for replay: rows applied by txn_id, or None.
+        Connector state is the truth — the journal's marker may be missing
+        when the coordinator died between connector commit and journal ack."""
+        state = self._write_state()
+        with state["lock"]:
+            return state["committed"].get(txn_id)
+
+    def orphaned_staging(self) -> dict:
+        """txn_id -> age in seconds for every staged-but-unresolved write;
+        the coordinator's janitor sweep reclaims stale ones."""
+        state = self._write_state()
+        now = time.time()
+        with state["lock"]:
+            return {t: now - h.created_at for t, h in state["staged"].items()}
+
+    def reclaim_staging(self, txn_id: str) -> int:
+        """Abort an orphaned staged write by id; returns staged bytes freed."""
+        state = self._write_state()
+        with state["lock"]:
+            handle = state["staged"].get(txn_id)
+        if handle is None:
+            return 0
+        return self.abort_write(handle)
 
 
 class CatalogManager:

@@ -47,7 +47,9 @@ from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
-from .failure import Backoff, FailureDetector
+from .failure import (
+    Backoff, FailureDetector, FaultInjector, InjectedCommitCrash,
+)
 # imported unconditionally: fleet.py registers the fleet metric families in
 # the GLOBAL registry at import, so /metrics carries their HELP strings even
 # on single-coordinator deployments (scripts/metrics_lint.py contract)
@@ -245,6 +247,10 @@ class Coordinator:
             probe_interval=heartbeat_interval * 2,
             on_transition=lambda url, old, new: self._m_breaker.labels(new).inc(),
         )
+        # coordinator-side fault matrix for the WRITE plane (runtime/txn.py
+        # consumes COMMIT_CRASH / WRITE_STALL rules at each phase boundary);
+        # worker-side task faults keep their own injectors on the workers
+        self.fault_injector = FaultInjector()
         # finished queries older than this are expired (record + spooled
         # segments GC'd) by the heartbeat sweep; 0 disables
         self.query_expiration_seconds = 900.0
@@ -457,6 +463,13 @@ class Coordinator:
         self.events.fire(
             QueryEvent("resumed", sm.query_id, (jq.sql or "")[:500])
         )
+        if jq.write_intents:
+            # write-plane replay is exactly-once, never re-execute: the
+            # commit marker decides no-op vs abort regardless of policy —
+            # re-running the statement under either policy could double-
+            # apply a write whose commit landed but whose ack did not
+            self._resume_write_txn(record, jq)
+            return
         if policy == "FAIL":
             reason = (
                 "Query was abandoned by a coordinator restart "
@@ -495,6 +508,156 @@ class Coordinator:
         except QueryRejected as e:
             sm.fail(str(e))
             record["done"].set()
+
+    def _resume_write_txn(self, record: dict, jq) -> None:
+        """Exactly-once DML replay: a recovered query with journaled write
+        intents never re-executes its statement.  Per intent the commit
+        marker decides — the journal's write_commit record OR the
+        connector's durable committed-marker (`txn_committed`; the
+        coordinator may die between the connector commit and the journal
+        ack, so connector state is truth) means the write landed and the
+        query replays as a NO-OP reporting the committed row count; no
+        marker means the intent aborts and its staging is reclaimed, the
+        target left byte-identical to the pre-image."""
+        from .txn import RECLAIMED_TOTAL, TXN_TOTAL
+
+        sm: QueryStateMachine = record["sm"]
+        surface = _statement_surface(self)
+        committed_rows: Optional[int] = None
+        for txn_id in sorted(jq.write_intents):
+            intent = jq.write_intents[txn_id]
+            catalog = intent.get("catalog") or self.default_catalog
+            table = intent.get("table") or ""
+            try:
+                conn, tbl = surface._target_conn(f"{catalog}.{table}")
+            except KeyError:
+                conn, tbl = None, table
+            rows = jq.write_commits.get(txn_id)
+            if rows is None and conn is not None:
+                try:
+                    rows = conn.txn_committed(tbl, txn_id)
+                except Exception:
+                    rows = None
+            if rows is not None:
+                if txn_id not in jq.write_commits and self.journal is not None:
+                    # journal repair: the connector committed but the marker
+                    # never hit disk (death inside the ack window) — re-
+                    # journal it so the NEXT replay short-circuits here
+                    self.journal.append(
+                        "write_commit", sm.query_id, txn_id=txn_id,
+                        rows=int(rows),
+                    )
+                committed_rows = int(rows)
+                TXN_TOTAL.labels("replayed_noop").inc()
+                _fr.record(
+                    "txn_replay_noop", txn_id=txn_id,
+                    table=f"{catalog}.{table}", rows=int(rows),
+                )
+                # the write IS visible: fire the same invalidation the lost
+                # ack would have (matters on adoption — the adopter's caches
+                # can be warm with the pre-image)
+                try:
+                    surface.cache_invalidate(f"{catalog}.{table}")
+                except Exception:
+                    traceback.print_exc()
+            elif txn_id in jq.write_aborts:
+                continue  # cleanly aborted before the crash: nothing to do
+            else:
+                freed = 0
+                if conn is not None:
+                    try:
+                        freed = int(conn.reclaim_staging(txn_id) or 0)
+                    except Exception:
+                        traceback.print_exc()
+                if freed:
+                    RECLAIMED_TOTAL.inc(freed)
+                TXN_TOTAL.labels("aborted").inc()
+                if self.journal is not None:
+                    self.journal.append(
+                        "write_abort", sm.query_id, txn_id=txn_id,
+                        reason="coordinator restart", outcome="aborted",
+                    )
+                _fr.record(
+                    "txn_replay_abort", txn_id=txn_id,
+                    table=f"{catalog}.{table}", freed_bytes=freed,
+                )
+        if committed_rows is not None:
+            sm.transition("PLANNING")
+            sm.transition("RUNNING")
+            record["result"] = [(committed_rows,)]
+            record["columns"] = ["col0"]
+            sm.transition("FINISHED")
+            if self.journal is not None:
+                self.journal.append(
+                    "finish", sm.query_id, state="FINISHED",
+                    error=None, error_code=None,
+                )
+            self._m_resumed.labels("completed").inc()
+        else:
+            reason = (
+                "Write transaction aborted by coordinator restart: the "
+                "intent was journaled but never committed; staged data "
+                "reclaimed, table unchanged [WRITE_ABORTED]"
+            )
+            if self.journal is not None:
+                self.journal.append(
+                    "finish", sm.query_id, state="FAILED",
+                    error=reason, error_code="WRITE_ABORTED",
+                )
+            sm.fail(reason, code="WRITE_ABORTED")
+            self._m_resumed.labels("failed").inc()
+        record["done"].set()
+        self._m_queries.labels(sm.state).inc()
+        try:  # history must never fail a replayed write
+            self.history.record(self._history_record(record, 0.0))
+        except Exception:
+            traceback.print_exc()
+
+    def _gc_write_staging(self) -> None:
+        """Write-staging janitor (rides the heartbeat sweep like
+        _gc_spool): a connector staging namespace whose txn's query is not
+        live anywhere — locally or in any fleet peer's lease — past the
+        grace window is an orphan from a crashed writer whose journal
+        nobody replayed (e.g. journal-less deployments).  Reclaim it and
+        account the bytes; replay-driven reclaim (_resume_write_txn) is
+        the fast path and usually gets there first."""
+        if self.fleet is not None and not self.fleet.is_gc_owner():
+            return  # destructive sweeps are single-owner in a fleet
+        try:
+            grace = float(self.session.get("write_staging_grace_s") or 10.0)
+        except Exception:
+            grace = 10.0
+        with self._lock:
+            live = {
+                qid for qid, rec in self.queries.items()
+                if not rec["sm"].done
+            }
+        if self.fleet is not None:
+            live |= self.fleet.fleet_live_queries()
+        from .txn import RECLAIMED_TOTAL
+
+        for cname in self.catalogs.names():
+            try:
+                conn = self.catalogs.get(cname)
+                orphans = conn.orphaned_staging()
+            except Exception:
+                continue
+            for txn_id, age_s in orphans.items():
+                qid = txn_id.rsplit("-w", 1)[0]
+                if qid in live or age_s < grace:
+                    continue
+                try:
+                    freed = int(conn.reclaim_staging(txn_id) or 0)
+                except Exception:
+                    traceback.print_exc()
+                    continue
+                if freed:
+                    RECLAIMED_TOTAL.inc(freed)
+                _fr.record(
+                    "txn_janitor", node=self.url, catalog=cname,
+                    txn_id=txn_id, freed_bytes=freed,
+                    age_s=round(age_s, 3),
+                )
 
     # --------------------------------------------------- fleet membership
     def _fleet_tick(self) -> None:
@@ -559,6 +722,22 @@ class Coordinator:
                             "commit", qid, fragment=fid, part=part,
                             task_id=tid,
                         )
+                # the write plane's exactly-once chain must survive a
+                # second crash too: carry the peer's intents and markers
+                # into OUR journal before replaying them
+                for txn_id, intent in jq.write_intents.items():
+                    self.journal.append(
+                        "write_intent", qid, txn_id=txn_id, **intent
+                    )
+                for txn_id, rows in jq.write_commits.items():
+                    self.journal.append(
+                        "write_commit", qid, txn_id=txn_id, rows=rows
+                    )
+                for txn_id in jq.write_aborts:
+                    self.journal.append(
+                        "write_abort", qid, txn_id=txn_id,
+                        reason="aborted before adoption", outcome="aborted",
+                    )
             adopted.append(record)
         for record in adopted:
             FLEET_ADOPTIONS.inc()
@@ -646,6 +825,7 @@ class Coordinator:
             self._fleet_tick()
             self._sweep_orphan_tasks(infos)
             self._gc_spool()
+            self._gc_write_staging()
 
     def _sweep_orphan_tasks(self, workers) -> None:
         """Adopt-or-cancel sweep (journal-gated): list each worker's tasks
@@ -1611,6 +1791,10 @@ class Coordinator:
                     if record.get("cancel"):
                         raise RuntimeError("Query was canceled")
                     surface = _statement_surface(self)
+                    # txn ids derive from the query id (qid-w<seq>) so a
+                    # journal replay can pair write intents with the query
+                    surface._txn_local.query_id = sm.query_id
+                    surface._txn_local.write_seq = 0
                     rows = surface.execute_stmt(
                         stmt, prepared=record.get("prepared")
                     )
@@ -1654,6 +1838,14 @@ class Coordinator:
                     elif isinstance(stmt, S.Deallocate):
                         record["deallocatedPrepare"] = [stmt.name]
                     sm.transition("FINISHED")
+                except InjectedCommitCrash:
+                    # simulated hard death at a write-phase boundary: die
+                    # exactly like kill() mid-statement — no abort, no
+                    # terminal state, no journal finish record, server gone.
+                    # Recovery is the restarted/adopting coordinator's
+                    # journal replay (_resume_write_txn).
+                    self.kill()
+                    return
                 except Exception as e:
                     traceback.print_exc()
                     sm.fail(str(e))
@@ -3248,6 +3440,15 @@ def _statement_surface(coord: "Coordinator"):
             # engine's — same typed hooks as runtime/dml.py
             self.result_cache = coord.result_cache
             self.fragment_memo = coord.fragment_memo
+            # write-transaction plane (runtime/txn.py): DML through this
+            # surface journals intents/commit markers into the COORDINATOR
+            # journal and honors its armed write faults; _run_inner stamps
+            # _txn_local.query_id per statement so txn ids chain to the
+            # journaled query
+            self.txn_journal = coord.journal
+            self.write_fault_injector = coord.fault_injector
+            self._txn_local = threading.local()
+            self._last_txn_info = None
 
         def plan(self, sql_or_query):
             return optimize(self.planner.plan(sql_or_query), self.catalogs, self.session)

@@ -16,11 +16,12 @@ First-match-wins across WHEN clauses is encoded with a computed action
 marker (CASE ... THEN 'u0'/'d'/'k'), mirroring the reference's merge row
 operations (spi/connector/MergePage: insert/delete/update ops per row).
 
-The swap is guarded, not atomic: all new contents are computed BEFORE any
-mutation, and connectors exposing snapshot()/restore() are rolled back if
-the write half fails partway (memory and iceberg connectors do).
-
-Connectors opt in by implementing `truncate` (memory connector does).
+The swap is transactional (runtime/txn.py): the statement journals a write
+intent, its new contents are computed as a query over the live pre-image
+and STAGED via the connector's begin_write handle (never touching the live
+table), then committed at a single atomic point guarded by a snapshot CAS
+— with the commit marker journaled for exactly-once crash replay and cache
+invalidation fired only after the commit lands.
 """
 
 from __future__ import annotations
@@ -33,6 +34,7 @@ from ..sql.ast import (
     Select, SelectItem, Star, StrLit, SubqueryRelation, Table, JoinRelation,
     Exists, IntLit,
 )
+from .txn import run_write
 
 __all__ = ["execute_delete", "execute_update", "execute_merge"]
 
@@ -46,31 +48,30 @@ def _is_true(pred: Expr) -> Expr:
     return FuncCall("coalesce", (pred, BoolLit(False)))
 
 
-def _replace(conn, table: str, engine, query: Query) -> int:
-    """Run `query`, swap its result in as the new contents of `table`.
-    Returns the new row count.  The query runs BEFORE the truncate and a
-    connector snapshot (if supported) restores the pre-image when the write
-    half fails partway."""
-    names, types, cols = engine._query_columns(query)
+def _stage_replace(txn, engine, query: Query) -> int:
+    """Run `query` over the live pre-image and stage its result as the
+    table's replacement contents.  Returns the staged (new) row count.
+    Nothing mutates: staging is invisible until txn.commit()."""
+    names, _types, cols = engine._query_columns(query)
     n = len(cols[0]) if cols else 0
-    snap = conn.snapshot() if hasattr(conn, "snapshot") else None
-    try:
-        conn.truncate(table)
-        engine._insert_resolved(conn, table, names, cols)
-    except Exception:
-        if snap is not None:
-            conn.restore(snap)
-        raise
+    txn.stage_truncate()
+    engine._insert_resolved(txn.conn, txn.table, names, cols, stage=txn)
     return n
 
 
 def execute_delete(engine, stmt: S.Delete) -> int:
     conn, catalog, table = engine._target_ref(stmt.table)
-    old_n = conn.estimated_row_count(table) or 0
     if stmt.where is None:
-        conn.truncate(table)
-        engine.cache_invalidate(f"{catalog}.{table}")
-        return old_n
+        # bare DELETE FROM t rides the same transactional staged-swap path
+        # as predicated DML (it used to truncate in place with no snapshot
+        # guard at all — a crash mid-statement lost the table)
+        def _truncate_all(txn):
+            old_n = conn.estimated_row_count(table) or 0
+            txn.stage_truncate()
+            return old_n
+
+        return run_write(engine, catalog, table, "delete", _truncate_all)
+
     survivors = Query(
         Select(
             items=(Star(),),
@@ -78,9 +79,15 @@ def execute_delete(engine, stmt: S.Delete) -> int:
             where=_not_true(stmt.where),
         )
     )
-    new_n = _replace(conn, table, engine, survivors)
-    engine.cache_invalidate(f"{catalog}.{table}")
-    return old_n - new_n
+
+    def _attempt(txn):
+        # recomputed per attempt: a conflict retry re-reads the fresh
+        # pre-image instead of re-staging stale survivors
+        old_n = conn.estimated_row_count(table) or 0
+        new_n = _stage_replace(txn, engine, survivors)
+        return old_n - new_n
+
+    return run_write(engine, catalog, table, "delete", _attempt)
 
 
 def execute_update(engine, stmt: S.Update) -> int:
@@ -104,9 +111,8 @@ def execute_update(engine, stmt: S.Update) -> int:
     rewrite = Query(
         Select(items=tuple(items), relations=(Table(table, None, catalog),))
     )
-    if stmt.where is None:
-        affected = conn.estimated_row_count(table) or 0
-    else:
+    count_q = None
+    if stmt.where is not None:
         # count on the PRE-image: WHERE may reference assigned columns
         count_q = Query(
             Select(
@@ -115,10 +121,16 @@ def execute_update(engine, stmt: S.Update) -> int:
                 where=_is_true(stmt.where),
             )
         )
-        affected = int(engine.query(count_q)[0][0] or 0)
-    _replace(conn, table, engine, rewrite)
-    engine.cache_invalidate(f"{catalog}.{table}")
-    return affected
+
+    def _attempt(txn):
+        if count_q is None:
+            affected = conn.estimated_row_count(table) or 0
+        else:
+            affected = int(engine.query(count_q)[0][0] or 0)
+        _stage_replace(txn, engine, rewrite)
+        return affected
+
+    return run_write(engine, catalog, table, "update", _attempt)
 
 
 def execute_merge(engine, stmt: S.Merge) -> int:
@@ -158,6 +170,7 @@ def execute_merge(engine, stmt: S.Merge) -> int:
     matched_clauses = [c for c in stmt.clauses if c.matched]
     insert_clauses = [c for c in stmt.clauses if not c.matched]
 
+    guard: Optional[Query] = None
     if matched_clauses:
         # reference semantics: a target row matched by more than one source
         # row is an error ('One MERGE target table row matched more than one
@@ -195,12 +208,6 @@ def execute_merge(engine, stmt: S.Merge) -> int:
                 ),
             )
         )
-        worst = engine.query(guard)[0][0]
-        if worst is not None and worst > 1:
-            raise ValueError(
-                "MERGE: one target table row matched more than one source row"
-            )
-
     # action marker: first matching WHEN clause in order ('u<k>' update,
     # 'd' delete, 'k' keep)
     whens = []
@@ -274,9 +281,8 @@ def execute_merge(engine, stmt: S.Merge) -> int:
             )
         )
 
-    old_n = conn.estimated_row_count(table) or 0
     # affected = updated + deleted + inserted; count updates on the pre-image
-    upd_count = 0
+    cq: Optional[Query] = None
     if any(cl.kind == "update" for cl in matched_clauses):
         cq = Query(
             Select(
@@ -310,30 +316,33 @@ def execute_merge(engine, stmt: S.Merge) -> int:
                 ),
             )
         )
-        upd_count = int(engine.query(cq)[0][0] or 0)
-
-    ins_cols = None
-    if insert_query is not None:
-        _, _, ins_cols = engine._query_columns(insert_query)
-
-    # all new contents are computed; apply under a snapshot guard so a
-    # failure in the write half cannot leave survivors without the inserts.
-    # Insert-only MERGE skips the survivors rewrite entirely: the target is
-    # untouched (and the fan-out LEFT JOIN could otherwise duplicate target
-    # rows matched by several source rows).
-    snap = conn.snapshot() if hasattr(conn, "snapshot") else None
-    try:
+    # everything data-dependent runs INSIDE the attempt so a conflict retry
+    # recomputes against the fresh pre-image.  Survivors and inserts stage
+    # into one transaction and land at one commit point — insert-only MERGE
+    # skips the survivors rewrite entirely (the target is untouched, and the
+    # fan-out LEFT JOIN could otherwise duplicate target rows matched by
+    # several source rows).
+    def _attempt(txn):
+        if guard is not None:
+            worst = engine.query(guard)[0][0]
+            if worst is not None and worst > 1:
+                raise ValueError(
+                    "MERGE: one target table row matched more than one source row"
+                )
+        old_n = conn.estimated_row_count(table) or 0
+        upd_count = int(engine.query(cq)[0][0] or 0) if cq is not None else 0
+        ins_cols = None
+        if insert_query is not None:
+            _, _, ins_cols = engine._query_columns(insert_query)
         deleted = 0
         if matched_clauses:
-            new_n = _replace(conn, table, engine, survivors)
+            new_n = _stage_replace(txn, engine, survivors)
             deleted = old_n - new_n
         inserted = 0
         if ins_cols is not None:
             inserted = len(ins_cols[0]) if ins_cols else 0
-            engine._insert_resolved(conn, table, insert_names, ins_cols)
-    except Exception:
-        if snap is not None:
-            conn.restore(snap)
-        raise
-    engine.cache_invalidate(f"{catalog}.{table}")
-    return upd_count + deleted + inserted
+            engine._insert_resolved(conn, table, insert_names, ins_cols,
+                                    stage=txn)
+        return upd_count + deleted + inserted
+
+    return run_write(engine, catalog, table, "merge", _attempt)

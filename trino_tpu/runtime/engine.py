@@ -24,6 +24,7 @@ from ..exec.compiler import LocalExecutor
 from ..plan.nodes import PlanNode, TableScan, format_plan
 from ..plan.planner import Planner
 from .session import SessionProperties
+from .txn import run_write  # imported eagerly: registers the txn metrics
 
 __all__ = ["Engine"]
 
@@ -110,6 +111,15 @@ class Engine:
         # the coordinator's cached results; None on a plain local engine
         self.result_cache = None
         self.fragment_memo = None
+        # write-transaction plane (runtime/txn.py): the coordinator surface
+        # threads its QueryJournal + FaultInjector through; a plain local
+        # engine runs the same staged-commit protocol without durability
+        import threading as _threading
+
+        self.txn_journal = None
+        self.write_fault_injector = None
+        self._txn_local = _threading.local()
+        self._last_txn_info = None  # EXPLAIN ANALYZE `-- txn:` footer
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -370,38 +380,54 @@ class Engine:
             conn, name = self._target_conn(stmt.name)
             if stmt.if_not_exists and name in conn.list_tables():
                 return [(0,)]
-            names, types, cols = self._query_columns(stmt.query)
-            conn.create_table(
-                name, [ColumnSchema(n, t) for n, t in zip(names, types)]
-            )
-            n = conn.insert(name, dict(zip(names, cols)))
-            self.cache_invalidate(stmt.name)
+            _, catalog, _ = self._target_ref(stmt.name)
+
+            def _ctas(txn):
+                # recomputed per attempt: a conflict retry must stage
+                # against the fresh snapshot, not stale arrays
+                names, types, cols = self._query_columns(stmt.query)
+                txn.stage_create(
+                    [ColumnSchema(n, t) for n, t in zip(names, types)]
+                )
+                txn.stage_insert(dict(zip(names, cols)))
+                return 0
+
+            n = run_write(self, catalog, name, "create", _ctas)
             return [(n,)]
 
         if isinstance(stmt, S.Insert):
-            _, types, cols = self._query_columns(stmt.query)
             conn, table = self._target_conn(stmt.table)
-            schema = conn.table_schema(table)
-            names = (
-                list(stmt.columns)
-                if stmt.columns
-                else [c.name for c in schema.columns]
-            )
-            if len(names) != len(cols):
-                raise ValueError(
-                    f"INSERT column count mismatch: {len(names)} vs {len(cols)}"
+            _, catalog, _ = self._target_ref(stmt.table)
+
+            def _insert(txn):
+                _, types, cols = self._query_columns(stmt.query)
+                schema = conn.table_schema(table)
+                names = (
+                    list(stmt.columns)
+                    if stmt.columns
+                    else [c.name for c in schema.columns]
                 )
-            cols = [
-                _rescale_column(arr, t, schema.type_of(n))
-                for arr, t, n in zip(cols, types, names)
-            ]
-            n = self._insert_resolved(conn, table, names, cols)
-            self.cache_invalidate(stmt.table)
+                if len(names) != len(cols):
+                    raise ValueError(
+                        f"INSERT column count mismatch: {len(names)} vs {len(cols)}"
+                    )
+                cols2 = [
+                    _rescale_column(arr, t, schema.type_of(n))
+                    for arr, t, n in zip(cols, types, names)
+                ]
+                return self._insert_resolved(conn, table, names, cols2,
+                                             stage=txn)
+
+            n = run_write(self, catalog, table, "insert", _insert)
             return [(n,)]
 
         if isinstance(stmt, S.InsertValues):
-            n = self._insert_values(stmt)
-            self.cache_invalidate(stmt.table)
+            _, catalog, table = self._target_ref(stmt.table)
+            table = table.split(".")[-1]
+            n = run_write(
+                self, catalog, table, "insert",
+                lambda txn: self._insert_values(stmt, stage=txn),
+            )
             return [(n,)]
 
         if isinstance(stmt, S.DropTable):
@@ -613,6 +639,8 @@ class Engine:
 
         if stmt.execute is not None:
             return self._explain_execute(stmt, prepared)
+        if stmt.statement is not None:
+            return self._explain_write(stmt, prepared)
         fmt = str(self.session.get("explain_format") or "text").lower()
         plan = self.plan(stmt.query)
         if not stmt.analyze:
@@ -685,6 +713,43 @@ class Engine:
         wall = _time.perf_counter() - t0
         text = format_plan(plan).splitlines()
         text.append(f"-- output rows: {len(rows)}, wall: {wall * 1000:.1f} ms")
+        return [(line,) for line in text]
+
+    def _explain_write(self, stmt, prepared: Optional[dict] = None) -> list[tuple]:
+        """EXPLAIN [ANALYZE] over a write statement.  Plain EXPLAIN renders
+        the source query's plan (if any) plus the write target without
+        executing; ANALYZE executes the statement through the transactional
+        path and appends the `-- txn:` commit-protocol footer."""
+        from ..sql import statements as S
+
+        inner = stmt.statement
+        text: list[str] = []
+        target = getattr(inner, "table", None) or getattr(inner, "name", None) \
+            or getattr(inner, "target", None)
+        op = type(inner).__name__
+        text.append(f"Write[{op} -> {target}]")
+        src = getattr(inner, "query", None)
+        if src is not None and not isinstance(inner, S.Merge):
+            text.extend(
+                "  " + ln for ln in format_plan(self.plan(src)).splitlines()
+            )
+        if not stmt.analyze:
+            return [(line,) for line in text]
+        t0 = _time.perf_counter()
+        rows = self.execute_stmt(inner, prepared=prepared)
+        wall = _time.perf_counter() - t0
+        n = rows[0][0] if rows and rows[0] else 0
+        text.append(f"-- output rows: {n}, wall: {wall * 1e3:.1f} ms")
+        info = self._last_txn_info
+        if info is not None:
+            text.append(
+                f"-- txn: id={info['txn_id']} table={info['table']}"
+                f" op={info['operation']} expected={info['expected']}"
+                f" staged_bytes={info['staged_bytes']}"
+                f" retries={info.get('retries', 0)}"
+                f" outcome={info['outcome']}"
+                f" commit_ms={info['commit_ms']:.1f}"
+            )
         return [(line,) for line in text]
 
     @staticmethod
@@ -944,7 +1009,11 @@ class Engine:
         names = list(columns) if columns else [c.name for c in schema.columns]
         return self._insert_resolved(conn, table, names, cols)
 
-    def _insert_resolved(self, conn, table: str, names: list, cols: list) -> int:
+    def _insert_resolved(
+        self, conn, table: str, names: list, cols: list, stage=None
+    ) -> int:
+        """Resolve query columns against the table schema and either insert
+        directly (legacy path) or stage into the given WriteTransaction."""
         schema = conn.table_schema(table)
         if len(names) != len(cols):
             raise ValueError(f"INSERT column count mismatch: {len(names)} vs {len(cols)}")
@@ -963,9 +1032,12 @@ class Engine:
                 data[c.name] = np.zeros(
                     (n,), dtype=object if c.type.is_string else c.type.np_dtype
                 )
+        if stage is not None:
+            stage.stage_insert(data)
+            return n
         return conn.insert(table, data)
 
-    def _insert_values(self, stmt) -> int:
+    def _insert_values(self, stmt, stage=None) -> int:
         from ..plan.ir import Const
         from ..plan.planner import Scope, _Translator
 
@@ -1008,4 +1080,7 @@ class Engine:
                 data[c.name] = np.zeros(
                     (n,), dtype=object if c.type.is_string else c.type.np_dtype
                 )
+        if stage is not None:
+            stage.stage_insert(data)
+            return n
         return conn.insert(table, data)

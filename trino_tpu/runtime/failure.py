@@ -353,7 +353,7 @@ class FaultInjector:
     MODES = (
         "ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP", "CORRUPT",
         "MEMORY_PRESSURE", "COMPILE_SLOW", "COMPILE_FAIL", "SPLIT_LOST",
-        "SPOOL_LOST", "DISK_FULL",
+        "SPOOL_LOST", "DISK_FULL", "COMMIT_CRASH", "WRITE_STALL",
     )
 
     def __init__(self):
@@ -456,8 +456,38 @@ class FaultInjector:
         page-fetch request (end-to-end integrity check exercise)."""
         return self._take(task_id, ("CORRUPT",)) is not None
 
+    def write_fault(
+        self, key: str, sleep: Callable[[float], None] = time.sleep
+    ) -> None:
+        """Apply any armed COMMIT_CRASH / WRITE_STALL fault inside the
+        write-transaction phase machinery (runtime/txn.py).  `key` is
+        "<phase>:<txn_id>" with phase in intent|commit|ack, so a rule armed
+        with task_id "commit:" crashes every txn exactly at the
+        staged-but-uncommitted boundary (prefix match).  COMMIT_CRASH
+        raises InjectedCommitCrash — the txn layer re-raises WITHOUT
+        aborting, and the coordinator treats it as a hard kill, leaving
+        exactly the journal/connector state a real crash would.
+        WRITE_STALL sleeps delay_ms, widening the commit race window so
+        two-writer CAS conflicts are deterministic to provoke."""
+        rule = self._take(key, ("COMMIT_CRASH", "WRITE_STALL"))
+        if rule is None:
+            return
+        if rule.mode == "COMMIT_CRASH":
+            raise InjectedCommitCrash(f"injected commit crash at {key}")
+        if rule.delay_ms:
+            sleep(rule.delay_ms / 1000.0)
+
     def record_fired(self, mode: str, task_id: str) -> None:
         """Observability entry for faults applied outside _take (e.g.
         MEMORY_PRESSURE, consumed at arm time by the worker handler)."""
         with self._lock:
             self.fired.append((mode, task_id))
+
+
+class InjectedCommitCrash(RuntimeError):
+    """A simulated hard coordinator death at a write-txn phase boundary.
+
+    Distinct from ordinary statement failures on purpose: the txn layer
+    must NOT abort (a real crash cleans nothing up), and the coordinator
+    must swallow it like kill() — no terminal journal record, no done
+    event — so restart/adoption replay is exercised for real."""

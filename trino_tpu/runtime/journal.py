@@ -3,12 +3,17 @@
 Append-only JSONL beside the history store (runtime/history.py), recording
 enough of each query's life to resume it after a coordinator crash:
 
-  admit     query id, SQL text, explicit session overrides
-  dispatch  one fragment's task fan-out (fragment id, ntasks, attempt)
-  commit    one task's output COMMITTED to the spooled exchange
-            (fragment id, part, task id — the spool dir name)
-  resume    a restarted coordinator took over the query (policy, attempt)
-  finish    terminal state (FINISHED / FAILED / CANCELED)
+  admit         query id, SQL text, explicit session overrides
+  dispatch      one fragment's task fan-out (fragment id, ntasks, attempt)
+  commit        one task's output COMMITTED to the spooled exchange
+                (fragment id, part, task id — the spool dir name)
+  resume        a restarted coordinator took over the query (policy, attempt)
+  finish        terminal state (FINISHED / FAILED / CANCELED)
+  write_intent  a DML statement is about to stage data (txn id, catalog,
+                table, operation, expected version) — runtime/txn.py
+  write_commit  the txn's connector swap landed; replay treats the query's
+                write as done (exactly-once marker, keyed by txn id)
+  write_abort   the txn was rolled back; staging reclaimed
 
 Reference shape: the FTE promise that committed stage output is RE-READ,
 not recomputed (spi/exchange/ExchangeManager + trino-exchange-filesystem)
@@ -41,8 +46,13 @@ _JOURNAL_RECORDS = _metrics.GLOBAL.counter(
 )
 
 # record kinds that mark a state transition and therefore fsync; the rest
-# (dispatch/commit progress) only flush
-_FSYNC_KINDS = frozenset({"admit", "resume", "finish"})
+# (dispatch/commit progress) only flush.  All three write-txn kinds fsync:
+# the intent must be durable before staging mutates anything, and the
+# commit marker is the exactly-once guarantee — losing it would replay a
+# committed write as an abort.
+_FSYNC_KINDS = frozenset(
+    {"admit", "resume", "finish", "write_intent", "write_commit", "write_abort"}
+)
 
 
 class JournalQuery:
@@ -64,6 +74,11 @@ class JournalQuery:
         # first attempt number a resuming coordinator may use without
         # colliding with pre-crash task ids (max seen attempt + 1)
         self.next_attempt: int = 1
+        # write-transaction state (runtime/txn.py): txn id -> intent fields
+        self.write_intents: dict[str, dict] = {}
+        # txn id -> rows applied (the exactly-once commit marker)
+        self.write_commits: dict[str, int] = {}
+        self.write_aborts: set[str] = set()
 
 
 class QueryJournal:
@@ -171,4 +186,24 @@ class QueryJournal:
                 st.state = rec.get("state") or "FINISHED"
                 st.error = rec.get("error")
                 st.error_code = rec.get("error_code")
+            elif kind == "write_intent":
+                tid = rec.get("txn_id")
+                if tid:
+                    st.write_intents[str(tid)] = {
+                        "catalog": rec.get("catalog"),
+                        "table": rec.get("table"),
+                        "operation": rec.get("operation"),
+                        "expected": rec.get("expected"),
+                    }
+            elif kind == "write_commit":
+                tid = rec.get("txn_id")
+                if tid:
+                    try:
+                        st.write_commits[str(tid)] = int(rec.get("rows") or 0)
+                    except (TypeError, ValueError):
+                        st.write_commits[str(tid)] = 0
+            elif kind == "write_abort":
+                tid = rec.get("txn_id")
+                if tid:
+                    st.write_aborts.add(str(tid))
         return states

@@ -352,6 +352,14 @@ class ResultCache:
         fl.event.set()
 
     # ------------------------------------------------------------ inspection
+    def entries_for_table(self, catalog: str, table: str) -> int:
+        """Warm-entry count for ``catalog.table`` — the write plane's
+        exactly-once invalidation contract (invalidate at the commit point,
+        never on abort) is asserted against this in tests: a FAILED write
+        must leave the count unchanged."""
+        with self._lock:
+            return len(self._by_table.get(f"{catalog}.{table}", ()))
+
     def stats(self) -> dict:
         with self._lock:
             return {

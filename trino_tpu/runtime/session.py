@@ -84,6 +84,21 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 1.0,
         ),
         _Prop(
+            "write_conflict_retries", int, 2,
+            "recompute-and-retry budget when a DML statement loses the "
+            "commit-point snapshot CAS to a concurrent writer; past the "
+            "budget the statement fails typed WRITE_CONFLICT "
+            "(runtime/txn.py)",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "write_staging_grace_s", float, 10.0,
+            "janitor grace: staged write data older than this with no "
+            "live owning query is aborted and its bytes reclaimed by the "
+            "heartbeat sweep (orphaned staging from crashed writers)",
+            lambda v: v > 0,
+        ),
+        _Prop(
             "dispatch_queue_limit", int, 0,
             "coordinator load shedding: POST /v1/statement answers 429 + "
             "Retry-After when this many queries are already queued or "

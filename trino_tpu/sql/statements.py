@@ -97,14 +97,18 @@ class ShowCreateView(Statement):
 
 @dataclass(frozen=True)
 class Explain(Statement):
-    """EXPLAIN [ANALYZE] query | EXECUTE name [USING ...].  For EXPLAIN
-    EXECUTE (reference: sql/tree/Explain wrapping Execute) `query` is None
-    and `execute` carries the prepared-statement invocation."""
+    """EXPLAIN [ANALYZE] query | EXECUTE name [USING ...] | DML.  For
+    EXPLAIN EXECUTE (reference: sql/tree/Explain wrapping Execute) `query`
+    is None and `execute` carries the prepared-statement invocation; for
+    EXPLAIN [ANALYZE] INSERT/DELETE/UPDATE/MERGE/CTAS `query` is None and
+    `statement` carries the write statement (ANALYZE executes it and
+    appends the `-- txn:` commit-protocol footer)."""
 
     query: Optional[Query]
     analyze: bool = False
     distributed: bool = False
     execute: Optional["ExecuteStmt"] = None
+    statement: Optional[Statement] = None
 
 
 @dataclass(frozen=True)
@@ -250,6 +254,11 @@ def _parse_statement(p: "_Parser", sql: str = "") -> Statement:
                     if not p.accept_op(","):
                         break
             return Explain(None, analyze, distributed, ExecuteStmt(name, tuple(params)))
+        if p.peek_kw("INSERT", "DELETE", "UPDATE", "MERGE", "CREATE"):
+            # EXPLAIN [ANALYZE] <write statement>: recurse for the wrapped
+            # DML/CTAS (reference: sql/tree/Explain holds any Statement)
+            return Explain(None, analyze, distributed,
+                           statement=_parse_statement(p, sql))
         return Explain(p.parse_query(), analyze, distributed)
 
     if p.accept_kw("CREATE"):

@@ -21,7 +21,7 @@ from .runner import DistributedQueryRunner
 
 __all__ = [
     "ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES", "COMPILE_MODES",
-    "SPLIT_MODES", "STORAGE_MODES",
+    "SPLIT_MODES", "STORAGE_MODES", "WRITE_MODES",
 ]
 
 # modes that a retry_policy=TASK cluster must absorb without losing the
@@ -57,6 +57,18 @@ COMPILE_MODES = ("COMPILE_SLOW", "COMPILE_FAIL")
 # existing seeded schedules replay identically; pass
 # modes=RECOVERABLE_MODES + STORAGE_MODES to arm it alongside the rest.
 STORAGE_MODES = ("SPOOL_LOST", "DISK_FULL")
+
+# opt-in: write-plane chaos (runtime/txn.py phase boundaries).
+# COMMIT_CRASH simulates a hard coordinator death at intent|commit|ack —
+# the txn layer re-raises without abort and the coordinator swallows it like
+# kill(), so recovery must come from journal replay checking the commit
+# marker (exactly-once: no-op if committed, clean abort + staging reclaim if
+# not).  WRITE_STALL sleeps inside a phase (lease-timeout / janitor-grace
+# exercise).  These arm on the COORDINATOR's fault injector
+# (runner.inject_write_failure), not a worker's, and live in their own
+# tuple — not folded into RECOVERABLE_MODES — so existing seeded schedules
+# replay identically.
+WRITE_MODES = ("COMMIT_CRASH", "WRITE_STALL")
 
 # opt-in: split-plane chaos (runtime/splits.py).  SPLIT_LOST raises inside
 # one task's execution hook — under split_driven_scans a task IS one

@@ -250,6 +250,26 @@ class DistributedQueryRunner:
         )
         urllib.request.urlopen(req, timeout=10).read()
 
+    def inject_write_failure(
+        self,
+        phase: str = "commit",
+        txn_id: str = "",
+        mode: str = "COMMIT_CRASH",
+        delay_ms: int = 0,
+        count: int = 1,
+        coordinator_index: int = 0,
+    ) -> None:
+        """Arm one write-plane fault on a coordinator (runtime/txn.py hook
+        points).  `phase` is intent|commit|ack — the txn layer consults the
+        injector with key "<phase>:<txn_id>", so arming just a phase prefix
+        hits every write at that boundary.  COMMIT_CRASH simulates a hard
+        coordinator death mid-write (no abort, no terminal journal record);
+        WRITE_STALL sleeps delay_ms inside the phase."""
+        self.coordinators[coordinator_index].fault_injector.arm(
+            task_id=f"{phase}:{txn_id}", mode=mode, delay_ms=delay_ms,
+            count=count,
+        )
+
     def memory_pressure(self, worker_index: int, capacity_bytes: int) -> None:
         """Shrink one worker's NodeMemoryPool mid-run — the MEMORY_PRESSURE
         chaos lever.  Running reservations keep their bytes; new reserve()
