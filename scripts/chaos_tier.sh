@@ -50,6 +50,15 @@
 #           (spool-committed stages re-read, zero recompute) and the
 #           client rides through the router with zero visible failures;
 #           plus lease lifecycle, GC mutual exclusion, shard stability
+# Partition chaos (tests/test_multihost.py + tests/test_health.py):
+#   partition  asymmetric A->B partition mid-query (producer 503s only one
+#              consumer's fetches) and a GRAY_SLOW producer (correct but
+#              late pages, zero errors) on a 3-worker spooled cluster —
+#              the query completes byte-correct with zero client-visible
+#              failures, hedged_fetches_total{outcome="won"} > 0, the
+#              coordinator link matrix grades the impaired link while
+#              BOTH endpoints stay un-quarantined; plus the LinkHealth
+#              unit suite (EWMA grading, half-open probe, hedge quantile)
 # Write-plane chaos (tests/test_write_txn.py):
 #   write   COMMIT_CRASH at every phase boundary of the staged-commit
 #           protocol (intent / commit / ack) — the target table must be
@@ -112,6 +121,13 @@ case "${1:-}" in
   fleet)
     shift
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+        -p no:cacheprovider "$@"
+    ;;
+  partition)
+    shift
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_health.py \
+        tests/test_multihost.py -q \
+        -k "health or asymmetric_partition or gray_slow" \
         -p no:cacheprovider "$@"
     ;;
   write)

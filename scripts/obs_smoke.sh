@@ -16,7 +16,11 @@
 # round-trip over GET/POST /v1/query/{id}/postmortem, and the
 # transactional write plane — a DML through the staged-commit protocol
 # must carry the `-- txn:` footer and a nonzero
-# trino_tpu_write_txn_total{outcome="committed"} counter.
+# trino_tpu_write_txn_total{outcome="committed"} counter, and the
+# partition-tolerance plane — the cluster link matrix served on
+# /v1/info (consumer -> producer -> grade) and a nonzero
+# trino_tpu_hedged_fetches_total{outcome="won"} under an injected
+# GRAY_SLOW producer (the hedged spool fetch actually racing).
 #
 # Fast enough to run on every runtime/ or exec/ change; the same checks
 # run under the tier-1 gate via tests/test_obs_plane.py.
@@ -237,6 +241,55 @@ try:
     )
     print(f"spool reproductions counter: {repro[0].split()[-1]}")
 
+    # partition-tolerance plane (runtime/health.py): a GRAY_SLOW producer
+    # (correct pages, 800 ms late, zero errors) must drive the hedged
+    # spool fetch — the won counter moves — and the consumer-side link
+    # matrix must surface on the coordinator's /v1/info via heartbeats
+    def _hedged_won() -> float:
+        vals = []
+        for w in runner.workers:
+            for ln in get(f"{w.url}/metrics").splitlines():
+                if ln.startswith(
+                    'trino_tpu_hedged_fetches_total{outcome="won"}'
+                ):
+                    vals.append(float(ln.split()[-1]))
+        return max(vals) if vals else 0.0  # process-global: any node's view
+
+    won_before = _hedged_won()
+    # slow EVERY producer: with 2 workers the plan may place the whole
+    # partial stage on either one, so a single-producer fault can miss
+    # the one link the final stage actually fetches over
+    for wi in range(len(runner.workers)):
+        runner.gray_slow(producer_index=wi, delay_ms=800)
+    runner.query("select l_suppkey, count(*) from lineitem "
+                 "group by l_suppkey order by l_suppkey")
+    for w in runner.workers:
+        w.fault_injector.clear()
+    won_after = _hedged_won()
+    assert won_after > won_before, (
+        f"hedged won counter did not move under GRAY_SLOW: "
+        f"{won_before} -> {won_after}"
+    )
+    print(f"hedged fetches won counter: {won_before:.0f} -> {won_after:.0f}")
+
+    import time as _time
+    links = {}
+    lm_deadline = _time.monotonic() + 15  # next heartbeat folds the rows
+    while _time.monotonic() < lm_deadline:
+        links = json.loads(get(base + "/v1/info")).get("links") or {}
+        if links:
+            break
+        _time.sleep(0.5)
+    assert links, "expected a cluster link matrix on /v1/info"
+    cells = [
+        (c, p, cell.get("state"))
+        for c, row in links.items() for p, cell in row.items()
+    ]
+    assert all(s in ("HEALTHY", "DEGRADED", "SUSPECT", "DEAD")
+               for _, _, s in cells), cells
+    print(f"/v1/info link matrix: {len(links)} consumer rows, "
+          f"{len(cells)} links graded ok")
+
     # flight-recorder plane (utils/flightrecorder.py): the event counter
     # must have moved, and both node roles must serve their ring slice
     mtext4 = get(base + "/metrics")
@@ -267,6 +320,12 @@ try:
     coord.session.set("anomaly_min_samples", "1")
     ANOM_SQL = ("explain analyze select l_shipmode, count(*) c "
                 "from lineitem group by l_shipmode order by l_shipmode")
+    # warm the plan's jit signatures first (plain select: different
+    # baseline key, so this run is NOT a baseline sample) — otherwise
+    # first-compile cost inflates the clean baseline and the seeded SLOW
+    # run lands right at the 2x anomaly factor instead of far past it
+    runner.query("select l_shipmode, count(*) c from lineitem "
+                 "group by l_shipmode order by l_shipmode")
     runner.query(ANOM_SQL)  # clean run -> baseline sample
     for i in range(len(runner.workers)):
         runner.inject_task_failure(i, task_id="*", mode="SLOW",

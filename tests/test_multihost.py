@@ -192,6 +192,116 @@ def test_kill_worker_with_finished_stage_output_mid_query():
         runner.stop()
 
 
+def test_asymmetric_partition_hedged_exchange(tpch_tiny, oracle, tmp_path):
+    """Asymmetric partition drill (ISSUE acceptance): the A->B exchange
+    link black-holes mid-cluster — worker B 503s every results fetch that
+    identifies as coming from worker A, while B's heartbeats and every
+    other consumer's fetches keep working.  The query must complete
+    byte-correct with ZERO client-visible failures: A's LinkHealth grades
+    the link DEAD and the hedged fetch serves B's committed partitions
+    from the spool.  The coordinator's link matrix must report the
+    impaired link while BOTH endpoints stay dispatchable (nobody is
+    quarantined for a pairwise partition)."""
+    import time
+
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.runtime.health import HEDGED_FETCHES
+    from trino_tpu.testing import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(num_workers=3, heartbeat_interval=0.3)
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    try:
+        runner.coordinator.session.set("retry_policy", "TASK")
+        runner.coordinator.session.set("exchange_spool_dir", str(tmp_path))
+        # warm: compile caches AND each link's latency baseline/history
+        runner.query("select count(*) from lineitem")
+        won0 = HEDGED_FETCHES.value("won")
+        # partition A->B only: B (producer) drops A's (consumer) fetches
+        runner.partition_link(producer_index=1, consumer_index=0)
+        sql = QUERIES["q18"]
+        got = runner.query(sql)  # a raise here = client-visible failure
+        assert_rows_equal(got, oracle.query(sql), ordered=ORDERED["q18"])
+        # the hedge path actually carried traffic around the partition
+        assert HEDGED_FETCHES.value("won") > won0
+        # consumer-side verdict: A graded its link to B SUSPECT/DEAD
+        a, b = runner.workers[0], runner.workers[1]
+        assert a.link_health.state(b.url) in ("SUSPECT", "DEAD")
+        # coordinator vantage: the matrix shows the impaired link...
+        deadline = time.monotonic() + 10
+        impaired = {}
+        while time.monotonic() < deadline:
+            impaired = {
+                (c, p): cell["state"]
+                for c, row in runner.coordinator.link_matrix().items()
+                for p, cell in row.items()
+                if cell.get("state") != "HEALTHY"
+            }
+            if (a.url, b.url) in impaired:
+                break
+            time.sleep(0.2)
+        assert impaired.get((a.url, b.url)) in ("SUSPECT", "DEAD"), impaired
+        # ...while neither endpoint is quarantined: a pairwise partition
+        # is not a dead worker
+        det = runner.coordinator.failure_detector
+        assert det.is_dispatchable(a.url) and det.is_dispatchable(b.url)
+    finally:
+        runner.stop()
+
+
+def test_gray_slow_producer_hedge_wins(tpch_tiny, oracle, tmp_path):
+    """GRAY_SLOW drill: a producer serves exchange pages correctly but
+    late — no errors anywhere, so only the hedge race (fetch in flight
+    past the link's history-quantile delay -> spool re-read) keeps the
+    query off the slow path.  Zero client-visible failures; the hedged
+    won counter must move; the link grades from latency alone."""
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.runtime.health import HEDGED_FETCHES
+    from trino_tpu.testing import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(num_workers=3, heartbeat_interval=0.3)
+    runner.register_catalog("tpch", TpchConnector(0.01))
+    runner.start()
+    try:
+        runner.coordinator.session.set("retry_policy", "TASK")
+        runner.coordinator.session.set("exchange_spool_dir", str(tmp_path))
+        sql = QUERIES["q18"]
+        # warm with the SAME query: its all-to-all exchanges give every
+        # (consumer, producer) link a healthy baseline — a gray failure
+        # is judged against the link's OWN history, so a link whose
+        # first-ever sample is already slow cannot be graded
+        runner.query(sql)
+        won0 = HEDGED_FETCHES.value("won")
+        runner.gray_slow(producer_index=1, delay_ms=800)
+        got = runner.query(sql)
+        assert_rows_equal(got, oracle.query(sql), ordered=ORDERED["q18"])
+        assert HEDGED_FETCHES.value("won") > won0
+        # latency-only grading: some consumer saw the slowdown.  The slow
+        # primary responses land AFTER their hedge already won (the
+        # losing fetch still reports its latency when it completes), so
+        # give the last in-flight primaries a moment to score.
+        import time
+
+        b = runner.workers[1]
+        grades: set = set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            grades = {
+                w.link_health.state(b.url)
+                for w in runner.workers
+                if w is not b
+            }
+            if grades & {"DEGRADED", "SUSPECT", "DEAD"}:
+                break
+            time.sleep(0.2)
+        assert grades & {"DEGRADED", "SUSPECT", "DEAD"}, grades
+        # nobody quarantined: heartbeats never touched the fault
+        det = runner.coordinator.failure_detector
+        assert all(det.is_dispatchable(w.url) for w in runner.workers)
+    finally:
+        runner.stop()
+
+
 def test_statement_surface_via_coordinator(cluster, oracle):
     """DDL/DML/utility statements through the HTTP protocol: embedded
     SELECTs run distributed, metadata ops execute coordinator-side

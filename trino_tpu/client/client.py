@@ -26,6 +26,7 @@ class StatementClient:
         poll_interval: float = 0.05,
         spooled: bool = False, shed_retries: int = 0,
         reattach: bool = True, reattach_max_elapsed_s: float = 30.0,
+        total_deadline_s: float = 0.0,
     ):
         """spooled=True advertises the SPOOLED result protocol (reference:
         client/spooling SegmentLoader): when the server has a spool
@@ -49,7 +50,15 @@ class StatementClient:
         retrying one dead host until reattach_max_elapsed_s expires.  A
         query adopted by a surviving coordinator answers the same
         /v1/statement/{qid}/... path there, so the failed-over poll lands
-        on the live copy."""
+        on the live copy.
+
+        total_deadline_s > 0 caps the CUMULATIVE seconds this client will
+        sleep across every retry family — shed 429 Retry-After waits,
+        re-attach backoff, fleet-adoption 429/503 waits.  Each family's
+        own bound (shed_retries, reattach_max_elapsed_s) still applies;
+        the total cap closes the gap where the families chain (shed, then
+        reattach, then shed again) into an unbounded stall.  Exceeding it
+        raises QueryFailed with error_code CLIENT_DEADLINE."""
         if isinstance(server_url, str):
             endpoints = [server_url]
         else:
@@ -61,6 +70,8 @@ class StatementClient:
         self.shed_retries = shed_retries
         self.reattach = reattach
         self.reattach_max_elapsed_s = reattach_max_elapsed_s
+        self.total_deadline_s = total_deadline_s
+        self._retry_slept_s = 0.0  # cumulative retry sleep, all families
         # client-held prepared-statement registry (reference: ClientSession
         # preparedStatements): replayed on every request via the
         # X-Trino-Prepared-Statement header, updated from the terminal
@@ -68,6 +79,24 @@ class StatementClient:
         # works against a stateless (or restarted) coordinator
         self.prepared: dict[str, str] = {}
         self.last_query_id: Optional[str] = None
+
+    def _retry_sleep(self, seconds: float) -> None:
+        """Every retry-family sleep funnels through here so the cumulative
+        cap (total_deadline_s) covers shed waits + re-attach backoff +
+        adoption-window waits TOGETHER, not each family separately."""
+        if self.total_deadline_s > 0:
+            remaining = self.total_deadline_s - self._retry_slept_s
+            if remaining <= 0:
+                exc = QueryFailed(
+                    f"client retry budget exhausted: slept "
+                    f"{self._retry_slept_s:.1f}s across retries, "
+                    f"total_deadline_s={self.total_deadline_s}"
+                )
+                exc.error_code = "CLIENT_DEADLINE"
+                raise exc
+            seconds = min(seconds, remaining)
+        time.sleep(seconds)
+        self._retry_slept_s += seconds
 
     def _post_statement(self, sql: str, headers: dict) -> dict:
         """POST /v1/statement, honoring 429 + Retry-After backpressure.
@@ -94,7 +123,7 @@ class StatementClient:
                     except ValueError:
                         delay = 1.0
                     e.read()  # drain the shed response before re-posting
-                    time.sleep(delay)
+                    self._retry_sleep(delay)
                     last_err = None
                     break  # re-post to the SAME endpoint after the shed
                 except OSError as e:
@@ -209,9 +238,9 @@ class StatementClient:
                         raise
                     retry_after = e.headers.get("Retry-After")
                     if retry_after:
-                        time.sleep(min(float(retry_after), 2.0))
+                        self._retry_sleep(min(float(retry_after), 2.0))
                     else:
-                        backoff.sleep()
+                        self._retry_sleep(backoff.delay())
                     continue
                 raise
             except OSError:
@@ -239,7 +268,7 @@ class StatementClient:
                     )
                 if backoff.failure():
                     raise
-                backoff.sleep()
+                self._retry_sleep(backoff.delay())
 
     def submit(self, sql: str) -> str:
         """Fire-and-return: the query id (poll or cancel it later)."""

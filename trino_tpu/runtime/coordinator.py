@@ -71,10 +71,16 @@ from .wire import wire_to_page
 
 __all__ = ["Coordinator"]
 
-# typed marker a consuming worker raises when a producer's COMMITTED spool
-# partition turns out missing or corrupt at read time (runtime/worker.py):
-# the captured group is the producer task id to reproduce
-_SPOOL_LOST_RE = re.compile(r"SPOOL_LOST:([A-Za-z0-9_.\-]+):")
+# typed markers a consuming worker raises for an unreadable producer
+# (runtime/worker.py) — the captured group is the producer task id to
+# reproduce.  SPOOL_LOST = the producer's COMMITTED spool partition went
+# missing/corrupt at read time; EXCHANGE_UNREACHABLE = the link to the
+# producer is partitioned or the propagated deadline left no budget for
+# another fetch attempt.  Both recover the same way: re-run the producer
+# so its output is reproduced into the spool for the hedge path to read.
+_LOST_SOURCE_RE = re.compile(
+    r"(?:SPOOL_LOST|EXCHANGE_UNREACHABLE):([A-Za-z0-9_.\-]+):"
+)
 
 
 def _json_default(o):
@@ -103,6 +109,10 @@ class _WorkerInfo:
         # last node-disk-pool snapshot (runtime/disk.py): feeds the spool
         # pressure-reclaim escalation in the coordinator GC tick
         self.disk: Optional[dict] = None
+        # this worker's consumer-side view of its exchange links
+        # (runtime/health.py snapshot() shipped on /v1/info) — one ROW of
+        # the cluster link matrix: {producer_url: {state, error_ewma, ...}}
+        self.links: dict = {}
 
 
 class Coordinator:
@@ -230,6 +240,19 @@ class Coordinator:
             "Cross-node post-mortem bundles written, by trigger "
             "(failure / anomaly / on_demand)",
             ("trigger",),
+        )
+        # cluster link matrix (runtime/health.py): workers ship their
+        # consumer-side link grades on /v1/info; the coordinator folds the
+        # rows and steers task placement away from impaired links
+        self._m_links_impaired = self.metrics.gauge(
+            "trino_tpu_links_impaired",
+            "Exchange links in the cluster link matrix currently graded "
+            "worse than HEALTHY (summed over all consumer rows)",
+        )
+        self._m_link_avoided = self.metrics.counter(
+            "trino_tpu_link_avoided_dispatch_total",
+            "Task placements that skipped a candidate worker because the "
+            "cluster link matrix showed an impaired link touching it",
         )
         # postmortem bundles are disk-pool leased (runtime/disk.py) against
         # a small coordinator-side budget — lazily built on first write
@@ -769,6 +792,45 @@ class Coordinator:
         with self._lock:
             return [w.url for w in self.workers.values() if w.alive]
 
+    def link_matrix(self) -> dict[str, dict[str, dict]]:
+        """Cluster link matrix: consumer_url -> producer_url -> link cell
+        (runtime/health.py snapshot shape).  Each worker contributes the
+        row of links IT fetches over; the coordinator only relays.  Reading
+        the matrix against the failure detector distinguishes the failure
+        modes: every row to B DEAD + B's heartbeat failing = B is down;
+        only A's row to B DEAD while B heartbeats fine = the A->B link is
+        partitioned (B must NOT be quarantined for that)."""
+        with self._lock:
+            return {
+                w.url: dict(w.links) for w in self.workers.values() if w.links
+            }
+
+    def _link_penalty(self, url: str) -> int:
+        """Impaired-link count touching `url` (as producer or consumer) in
+        the matrix — the placement tie-breaker: a worker behind a broken
+        link can still run tasks, but an unimpaired peer is preferred."""
+        bad = 0
+        with self._lock:
+            for w in self.workers.values():
+                for prod, cell in (w.links or {}).items():
+                    if cell.get("state") in ("SUSPECT", "DEAD") and (
+                        prod == url or w.url == url
+                    ):
+                        bad += 1
+        return bad
+
+    def _steer_by_links(self, candidates: list[str]) -> list[str]:
+        """Drop candidates touching SUSPECT/DEAD links when at least one
+        clean candidate remains; never empties the pool (an impaired link
+        beats no placement at all — the hedge path still works there)."""
+        if len(candidates) < 2:
+            return candidates
+        good = [w for w in candidates if self._link_penalty(w) == 0]
+        if good and len(good) < len(candidates):
+            self._m_link_avoided.inc(len(candidates) - len(good))
+            return good
+        return candidates
+
     def _heartbeat_loop(self) -> None:
         """Heartbeat-driven failure detection (HeartbeatFailureDetector.
         java:76): each sweep probes workers, feeds latency/error outcomes
@@ -808,6 +870,25 @@ class Coordinator:
                     # disk-pool snapshots ride the same heartbeat: the GC
                     # tick below escalates spool reclaim under pressure
                     w.disk = info.get("disk_pool")
+                    # link matrix fold: the worker's consumer-side view of
+                    # every producer link it fetches over (runtime/health.py
+                    # snapshot()).  A row going SUSPECT/DEAD while this
+                    # heartbeat succeeds is the asymmetric-partition
+                    # signature: the worker-to-worker data path is broken
+                    # even though the coordinator's control path is fine.
+                    new_links = info.get("links") or {}
+                    for prod, cell in new_links.items():
+                        old_cell = (w.links or {}).get(prod) or {}
+                        if cell.get("state") != old_cell.get(
+                            "state", "HEALTHY"
+                        ):
+                            _fr.record(
+                                "link_state", node=self.url,
+                                consumer=w.url, producer=prod,
+                                old=old_cell.get("state", "HEALTHY"),
+                                new=cell.get("state"),
+                            )
+                    w.links = new_links
                 except Exception:
                     w.failures += 1
                     det.record_failure(w.url)
@@ -818,6 +899,14 @@ class Coordinator:
                         "worker_dead", node=self.url, worker=w.url,
                         failures=w.failures,
                     )
+            self._m_links_impaired.set(
+                sum(
+                    1
+                    for w in infos
+                    for cell in (w.links or {}).values()
+                    if cell.get("state") not in (None, "HEALTHY")
+                )
+            )
             self._enforce_cluster_memory(cluster_by_query)
             self._enforce_node_memory(mem_snapshots)
             self._enforce_deadlines()
@@ -2306,7 +2395,7 @@ class Coordinator:
                     err = str(self._task_info(w, lost_tid).get("error") or "")
                 except Exception:
                     err = ""
-                mm = _SPOOL_LOST_RE.search(err)
+                mm = _LOST_SOURCE_RE.search(err)
                 if not (mm and reproduce_lost(mm.group(1), _depth + 1)):
                     return False
             return False
@@ -2322,7 +2411,7 @@ class Coordinator:
                 err = str(self._task_info(u, tid).get("error") or "")
             except Exception:
                 return
-            m = _SPOOL_LOST_RE.search(err)
+            m = _LOST_SOURCE_RE.search(err)
             if m:
                 reproduce_lost(m.group(1))
 
@@ -2377,6 +2466,28 @@ class Coordinator:
                 ),
                 "compile_deadline_s": float(
                     self.session.get("compile_deadline_s") or 0.0
+                ),
+                # coherent deadline propagation: the query's absolute
+                # deadline (epoch seconds) rides every task POST (and the
+                # X-Trino-Deadline header, folded in worker do_POST) so
+                # each exchange hop computes its own remaining budget
+                # instead of burning the full per-fetch timeout against a
+                # query the watchdog is about to kill anyway
+                "deadline_ts": (
+                    sm.created_at
+                    + float(self.session.get("query_max_run_time_s") or 0)
+                    if float(self.session.get("query_max_run_time_s") or 0)
+                    > 0
+                    else 0.0
+                ),
+                "exchange_deadline_headroom_ms": int(
+                    self.session.get("exchange_deadline_headroom_ms") or 500
+                ),
+                "exchange_retry_rotate": int(
+                    self.session.get("exchange_retry_rotate") or 0
+                ),
+                "hedge_delay_quantile": float(
+                    self.session.get("hedge_delay_quantile") or 0.95
                 ),
             }
             if f.id in split_plans:
@@ -2487,6 +2598,7 @@ class Coordinator:
                     is_parked=self._split_parked,
                     query_id=sm.query_id,
                     node=self.url,
+                    link_penalty=self._link_penalty,
                 )
                 max_att = int(self.session.get("split_retry_limit") or 0) + 1
             self._progress_stage_begin(record, f.id, ntasks[f.id], len(pre))
@@ -2628,7 +2740,9 @@ class Coordinator:
                         # when the COMMITTED partition itself is lost or
                         # corrupt, self-heal by reproducing the producer
                         if spool is not None and (
-                            u == SPOOL_URL or "spooled chunk removed" in str(e)
+                            u == SPOOL_URL
+                            or "spooled chunk removed" in str(e)
+                            or "EXCHANGE_UNREACHABLE:" in str(e)
                         ):
                             reproduce_lost(t)
                         heal(child_id)
@@ -3013,7 +3127,7 @@ class Coordinator:
         spooled exchange's first-commit-wins rename arbitrates exactly-once
         on disk) with a distinct `attempt` label for its staging dir.  The
         first FINISHED attempt wins; the loser is aborted via DELETE."""
-        workers = self.alive_workers()
+        workers = self._steer_by_links(self.alive_workers())
         if not workers:
             raise RuntimeError("no alive workers")
         urls: list[Optional[tuple[str, str]]] = [None] * nparts
@@ -3050,7 +3164,9 @@ class Coordinator:
         def _dispatchable() -> list[str]:
             alive = self.alive_workers()
             d = [w for w in alive if self.failure_detector.is_dispatchable(w)]
-            return d or alive
+            # link matrix steering: among dispatchable workers, prefer the
+            # ones no impaired (SUSPECT/DEAD) exchange link touches
+            return self._steer_by_links(d or alive)
 
         def _assign_splits() -> None:
             # lazy split assignment: drain the scheduler's pool onto
@@ -3221,6 +3337,9 @@ class Coordinator:
                     alive = self.alive_workers()
                 if not alive:
                     raise RuntimeError("no alive workers for re-schedule")
+                # a retry caused by a partitioned link must not land back
+                # on a worker the matrix still shows behind a broken link
+                alive = self._steer_by_links(alive)
                 if refresh_sources is not None:
                     payload_base = dict(
                         payload_base, sources=refresh_sources()
@@ -3380,10 +3499,16 @@ class Coordinator:
             part=payload.get("part"), attempt=payload.get("attempt"),
         )
         body = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if payload.get("deadline_ts"):
+            # deadline coherence: the header mirrors the payload field so
+            # every hop (including proxies that only see headers) can
+            # compute remaining budget the same way
+            headers["X-Trino-Deadline"] = f"{payload['deadline_ts']:.3f}"
         req = urllib.request.Request(
             f"{worker_url}/v1/task/{payload['task_id']}",
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as r:
@@ -3728,6 +3853,19 @@ def _make_handler(coord: Coordinator):
                         f"{_mem_cells(w)}</tr>"
                         for w in list(coord.workers.values())
                     )
+                    # link matrix rows: only impaired links are rendered —
+                    # a fully healthy cluster shows an empty table
+                    lrows = "".join(
+                        f"<tr><td>{_html.escape(w.url)}</td>"
+                        f"<td>{_html.escape(prod)}</td>"
+                        f"<td>{_html.escape(str(cell.get('state')))}</td>"
+                        f"<td>{cell.get('error_ewma')}</td>"
+                        f"<td>{cell.get('latency_ewma_ms')}</td>"
+                        f"<td>{cell.get('consecutive_failures')}</td></tr>"
+                        for w in list(coord.workers.values())
+                        for prod, cell in sorted((w.links or {}).items())
+                        if cell.get("state") != "HEALTHY"
+                    )
                     nworkers = len(coord.workers)
                     nqueries = len(coord.queries)
                 # fleet membership table (lease files — own locking; render
@@ -3775,6 +3913,11 @@ def _make_handler(coord: Coordinator):
                     "<th>mem reserved/cap (B)</th><th>revocable (B)</th>"
                     "<th>blocked</th>"
                     f"</tr>{wrows}</table>"
+                    "<h3>impaired links</h3>"
+                    "<table><tr><th>consumer</th><th>producer</th>"
+                    "<th>grade</th><th>err ewma</th><th>lat ewma (ms)</th>"
+                    "<th>consec fail</th>"
+                    f"</tr>{lrows}</table>"
                     f"{fleet_html}"
                     f"<h3>queries ({nqueries})</h3>"
                     "<table><tr><th>id</th><th>state</th><th>wall (s)</th>"
@@ -3812,6 +3955,10 @@ def _make_handler(coord: Coordinator):
                     ],
                     "queries": len(coord.queries),
                     "resource_groups": coord.resource_groups.stats(),
+                    # cluster link matrix: consumer -> producer -> grade
+                    # cell; read alongside workers[].alive to tell "B is
+                    # down" from "only the A->B link is partitioned"
+                    "links": coord.link_matrix(),
                 }
                 if coord.fleet is not None:
                     info["fleet"] = coord.fleet.info()

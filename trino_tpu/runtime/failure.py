@@ -309,12 +309,23 @@ class _FaultRule:
     task_id: str  # "*" == any; otherwise exact id or prefix
     mode: str  # one of FaultInjector.MODES
     delay_ms: int = 0
-    count: int = 1  # firings remaining; <= 0 after exhaustion
+    count: int = 1  # firings remaining; < 0 == persistent (never exhausts)
     probability: float = 1.0
     rng: Optional[random.Random] = None
+    # pairwise link scoping for the PARTITION/GRAY_SLOW/FLAKY_LINK modes:
+    # "*" == any consumer; otherwise the rule only fires for fetch requests
+    # whose X-Trino-Consumer / ?consumer= identity carries this prefix —
+    # that is what makes an ASYMMETRIC partition expressible (A→B drops
+    # while coordinator→B and C→B stay clean)
+    consumer: str = "*"
 
     def matches(self, task_id: str) -> bool:
         return self.task_id == "*" or task_id.startswith(self.task_id)
+
+    def matches_consumer(self, consumer: str) -> bool:
+        return self.consumer == "*" or (consumer or "").startswith(
+            self.consumer
+        )
 
 
 class FaultInjector:
@@ -346,6 +357,17 @@ class FaultInjector:
     before the read, and the coordinator's self-healing path must re-run
     the producer.
 
+    Link faults (link_fault(task_id, consumer)) model the gray/asymmetric
+    failures of the exchange plane (runtime/health.py):
+      - PARTITION answers matching page fetches with 503 ONLY when the
+        requesting consumer matches the rule's `consumer` scope — a
+        pairwise drop matrix (A→B dead while coordinator→B is fine).
+      - GRAY_SLOW sleeps delay_ms then serves NORMALLY — a latency-only
+        gray failure with zero errors; only hedged fetches save the query.
+      - FLAKY_LINK drops probabilistically (probability + seed).
+    These are typically armed with count=-1 (persistent until clear()):
+    a partition does not heal after N requests.
+
     `probability` < 1 arms a probabilistic variant: each match fires with
     that probability using a per-rule seeded rng (deterministic chaos).
     """
@@ -354,6 +376,7 @@ class FaultInjector:
         "ERROR", "TIMEOUT", "SLOW", "EXCHANGE_DROP", "CORRUPT",
         "MEMORY_PRESSURE", "COMPILE_SLOW", "COMPILE_FAIL", "SPLIT_LOST",
         "SPOOL_LOST", "DISK_FULL", "COMMIT_CRASH", "WRITE_STALL",
+        "PARTITION", "GRAY_SLOW", "FLAKY_LINK",
     )
 
     def __init__(self):
@@ -369,6 +392,7 @@ class FaultInjector:
         count: int = 1,
         probability: float = 1.0,
         seed: Optional[int] = None,
+        consumer: str = "*",
     ) -> None:
         mode = mode.upper()
         if mode not in self.MODES:
@@ -380,6 +404,7 @@ class FaultInjector:
             count=int(count),
             probability=float(probability),
             rng=random.Random(seed) if probability < 1.0 else None,
+            consumer=consumer or "*",
         )
         with self._lock:
             self._rules.append(rule)
@@ -388,16 +413,26 @@ class FaultInjector:
         with self._lock:
             self._rules = []
 
-    def _take(self, task_id: str, modes: tuple[str, ...]) -> Optional[_FaultRule]:
+    def _take(
+        self,
+        task_id: str,
+        modes: tuple[str, ...],
+        consumer: Optional[str] = None,
+    ) -> Optional[_FaultRule]:
         with self._lock:
             for rule in self._rules:
                 if rule.mode not in modes or not rule.matches(task_id):
                     continue
+                if consumer is not None and not rule.matches_consumer(
+                    consumer
+                ):
+                    continue
                 if rule.rng is not None and rule.rng.random() >= rule.probability:
                     continue
-                rule.count -= 1
-                if rule.count <= 0:
-                    self._rules.remove(rule)
+                if rule.count > 0:  # count < 0 == persistent, never exhausts
+                    rule.count -= 1
+                    if rule.count <= 0:
+                        self._rules.remove(rule)
                 self.fired.append((rule.mode, task_id))
                 return rule
         return None
@@ -425,6 +460,30 @@ class FaultInjector:
     def drop_fetch(self, task_id: str) -> bool:
         """True == answer this page-fetch request with a transient 503."""
         return self._take(task_id, ("EXCHANGE_DROP",)) is not None
+
+    def link_fault(
+        self,
+        task_id: str,
+        consumer: str = "",
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> Optional[str]:
+        """Apply any armed pairwise link fault to this page-fetch request.
+        `consumer` is the requester's identity (X-Trino-Consumer / the
+        ?consumer= query param); rules scoped to a specific consumer only
+        fire for it — the asymmetric-partition lever.  Returns "drop" when
+        the caller must answer 503 (PARTITION, or a FLAKY_LINK roll that
+        hit), None to serve normally; GRAY_SLOW sleeps delay_ms here and
+        returns None — latency injected, zero errors."""
+        rule = self._take(
+            task_id, ("PARTITION", "GRAY_SLOW", "FLAKY_LINK"), consumer=consumer
+        )
+        if rule is None:
+            return None
+        if rule.mode == "GRAY_SLOW":
+            if rule.delay_ms:
+                sleep(rule.delay_ms / 1000.0)
+            return None
+        return "drop"
 
     def spool_lost(self, producer_task_id: str) -> bool:
         """True == the caller (a consuming worker about to read a spooled

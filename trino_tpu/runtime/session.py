@@ -349,6 +349,41 @@ PROPERTIES: dict[str, _Prop] = {
             lambda v: v >= 0,
         ),
         _Prop(
+            "hedge_delay_quantile", float, 0.95,
+            "hedged exchange fetches (runtime/health.py): a fetch still "
+            "in flight past this quantile of its link's success-latency "
+            "history races a direct read of the producer's spool-committed "
+            "partition; first result wins, the loser is canceled "
+            "(reference: the tail-at-scale hedged-request rule applied to "
+            "the FTE exchange)",
+            lambda v: 0.0 <= v <= 1.0,
+        ),
+        _Prop(
+            "exchange_deadline_headroom_ms", int, 500,
+            "coherent deadline propagation: every exchange fetch computes "
+            "its remaining budget from the X-Trino-Deadline header and "
+            "fails fast with typed EXCHANGE_UNREACHABLE when less than "
+            "this headroom remains — a partitioned fetch reroutes through "
+            "spool reproduction instead of burning whole-query wall",
+            lambda v: v >= 0,
+        ),
+        _Prop(
+            "link_suspect_threshold", float, 0.25,
+            "link-health grading (runtime/health.py): error EWMA at or "
+            "above this grades the (consumer→producer) link SUSPECT; the "
+            "coordinator's link matrix steers placement away from it",
+            lambda v: 0.0 < v <= 1.0,
+        ),
+        _Prop(
+            "exchange_retry_rotate", int, 3,
+            "transient exchange-fetch failures on one link before the "
+            "consumer stops re-hitting the same endpoint and rotates to "
+            "the hedge path (spool re-read / producer reproduction) with "
+            "a typed EXCHANGE_UNREACHABLE — instead of spinning on a dead "
+            "producer until the whole-query deadline; 0 = never rotate",
+            lambda v: v >= 0,
+        ),
+        _Prop(
             "split_target_rows", int, 65536,
             "target rows per scan split; rounded up to a power of two and "
             "used as the fixed scan-page capacity every morsel pads to, "

@@ -169,10 +169,16 @@ class SplitScheduler:
         is_parked: Optional[Callable[[str], bool]] = None,
         query_id: str = "",
         node: str = "",
+        link_penalty: Optional[Callable[[str], int]] = None,
     ):
         self.nsplits = int(nsplits)
         self.queue_depth = max(1, int(queue_depth))
         self._is_parked = is_parked or (lambda url: False)
+        # impaired-link count touching a worker (coordinator link matrix,
+        # runtime/health.py): a soft placement penalty ranked ahead of
+        # load — never a hard filter, so a cluster whose every link is
+        # impaired still schedules
+        self._link_penalty = link_penalty or (lambda url: 0)
         # flight-recorder attribution: the owning query and the
         # coordinator node this scheduler runs on (utils/flightrecorder.py)
         self.query_id = query_id
@@ -238,7 +244,12 @@ class SplitScheduler:
                     cands.append(w)
                 if not cands:
                     break
-                w = min(cands, key=lambda u: (self._load.get(u, 0), u))
+                w = min(
+                    cands,
+                    key=lambda u: (
+                        self._link_penalty(u), self._load.get(u, 0), u
+                    ),
+                )
                 p = self._pool.popleft()
                 self._inflight[p] = w
                 self._load[w] = self._load.get(w, 0) + 1
@@ -299,7 +310,12 @@ class SplitScheduler:
                 cands = [w for w in workers if w != exclude] or list(workers)
             if not cands:
                 return None
-            w = min(cands, key=lambda u: (self._load.get(u, 0), u))
+            w = min(
+                cands,
+                key=lambda u: (
+                    self._link_penalty(u), self._load.get(u, 0), u
+                ),
+            )
             self._inflight[part] = w
             self._load[w] = self._load.get(w, 0) + 1
             self.stats["retries"] += 1
@@ -345,7 +361,12 @@ class SplitScheduler:
                 reverse=True,  # most-loaded victim's newest split first
             )
             for _, p in cands:
-                thief = min(idle, key=lambda w: (self._load.get(w, 0), w))
+                thief = min(
+                    idle,
+                    key=lambda w: (
+                        self._link_penalty(w), self._load.get(w, 0), w
+                    ),
+                )
                 if thief == self._inflight.get(p):
                     continue
                 self._stolen.add(p)

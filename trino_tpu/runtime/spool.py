@@ -225,6 +225,17 @@ class SpooledExchange:
                 out.append(blob)
         return out
 
+    def try_read_chunks(
+        self, task_id: str, buffer_id: int
+    ) -> Optional[list[bytes]]:
+        """Hedge-path read (runtime/worker.py _fetch_source): the chunks
+        when the producer COMMITTED, None when it has not yet — a hedged
+        consumer polls this while its primary HTTP fetch is in flight, so
+        "not committed" is an expected answer, not an error."""
+        if not self.is_committed(task_id):
+            return None
+        return self.read_chunks(task_id, buffer_id)
+
     def discard(self, task_id: str) -> None:
         """Drop one task's committed dir AND any leftover staging dirs —
         the self-healing path clears a lost/corrupt partition so the

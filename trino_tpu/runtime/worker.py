@@ -36,8 +36,9 @@ Reference wiring this replaces (SURVEY §2.8, §3.2-3.3):
                               update that triggers spillable operators)
   POST /v1/inject_failure     test-only fault matrix (ERROR | TIMEOUT |
                               SLOW | EXCHANGE_DROP | CORRUPT |
-                              MEMORY_PRESSURE | DISK_FULL | SPOOL_LOST,
-                              counted/probabilistic;
+                              MEMORY_PRESSURE | DISK_FULL | SPOOL_LOST |
+                              PARTITION | GRAY_SLOW | FLAKY_LINK,
+                              counted/probabilistic/consumer-scoped;
                               execution/FailureInjector.java:33 — see
                               runtime/failure.py FaultInjector)
 
@@ -59,6 +60,7 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import quote, unquote
 
 from ..connectors.spi import CatalogManager
 from ..data.page import Page
@@ -69,6 +71,7 @@ from ..utils import metrics as _metrics
 from ..utils.tracing import Tracer, add_exporters_from_env
 from .disk import DiskExceeded, NodeDiskPool, guarded_write
 from .failure import Backoff, FaultInjector
+from .health import DEAD, DEADLINE_ABORTS, HEDGED_FETCHES, LinkHealth
 from .memory import NodeMemoryPool
 from .spool import SPOOL_URL, SpooledExchange
 from .wire import (
@@ -317,6 +320,16 @@ class Worker:
             self.memory_pool.name = f"worker:{self.port}"
         if self.disk_pool is not None:
             self.disk_pool.name = f"worker:{self.port}"
+        # consumer-side exchange link scorer (runtime/health.py): every
+        # fetch this worker makes from a producer feeds its (self→producer)
+        # link; the snapshot rides /v1/info so the coordinator can fold a
+        # cluster link matrix — the asymmetric-partition detector
+        self.link_health = LinkHealth(
+            on_transition=lambda producer, old, new: _fr.record(
+                "link_state", node=self.url, producer=producer,
+                old=old, new=new,
+            ),
+        )
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     def buffered_bytes(self) -> int:
@@ -792,8 +805,8 @@ class Worker:
                             SpooledExchange(req["exchange_dir"]).discard(t)
                         try:
                             blobs.extend(
-                                _stream_fetch(
-                                    u, t, buffer_id, ack=ack, node=self.url
+                                self._fetch_source(
+                                    u, t, buffer_id, ack=ack, req=req
                                 )
                             )
                         except RuntimeError as e:
@@ -915,6 +928,10 @@ class Worker:
             "fallback_reasons": _count_reasons(
                 getattr(executor, "fallback_events", []) or []
             ),
+            # link grades ride task stats too (not just the heartbeat):
+            # the coordinator sees a partition the moment the first
+            # affected task reports, not an interval later
+            "links_impaired": self.link_health.impaired(),
         }
 
         if task.canceled:
@@ -1013,6 +1030,113 @@ class Worker:
                 buffers[0].extend(page_to_wire_chunks(page))
             task.progress()  # each finished slice is a watchdog beat
         return buffers, rows_out, operators
+
+    # ---------------------------------------------------- hedged source fetch
+    def _fetch_source(
+        self, u: str, t: str, buffer_id: int, ack: bool, req: dict
+    ) -> list[bytes]:
+        """Fetch one producer buffer with link-health accounting, a
+        propagated deadline budget, and — when the durable exchange is
+        configured — a HEDGED alternate path: a fetch still in flight past
+        the link's history-quantile hedge delay (or whose link breaker is
+        already open) races a direct read of the producer's spool-committed
+        partition.  First result wins via the existing token idempotency;
+        the loser is canceled at its next attempt.  Reference: the tail-
+        at-scale hedged-request pattern applied to the FTE exchange."""
+        deadline_ts = float(req.get("deadline_ts") or 0.0)
+        headroom_s = (
+            float(req.get("exchange_deadline_headroom_ms") or 500.0) / 1000.0
+        )
+        rotate = int(req.get("exchange_retry_rotate") or 3)
+        quantile = float(req.get("hedge_delay_quantile") or 0.95)
+        exchange_dir = req.get("exchange_dir") or ""
+        lh = self.link_health
+
+        def _read_spool() -> Optional[list[bytes]]:
+            try:
+                return SpooledExchange(exchange_dir).try_read_chunks(
+                    t, buffer_id
+                )
+            except Exception:
+                return None  # corrupt/unreadable: the HTTP path decides
+
+        if not exchange_dir:
+            # no durable exchange => no hedge path: plain fetch, but the
+            # link still accrues health and honors the deadline budget
+            return _stream_fetch(
+                u, t, buffer_id, ack=ack, node=self.url, consumer=self.url,
+                health=lh, deadline_ts=deadline_ts, headroom_s=headroom_s,
+            )
+        if lh.state(u) == DEAD and not lh.should_probe(u):
+            # link breaker OPEN and the half-open window closed: skip the
+            # doomed primary entirely when the spool can serve (consult
+            # link state BEFORE re-hitting a dead endpoint)
+            blobs = _read_spool()
+            if blobs is not None:
+                HEDGED_FETCHES.labels("won").inc()
+                _fr.record(
+                    "hedged_fetch", node=self.url, task_id=t, producer=u,
+                    outcome="won", reason="breaker_open",
+                )
+                return blobs
+        result: dict = {}
+        done = threading.Event()
+        hedge_won = threading.Event()
+
+        def _primary():
+            try:
+                result["blobs"] = _stream_fetch(
+                    u, t, buffer_id, ack=ack, node=self.url,
+                    consumer=self.url, health=lh, deadline_ts=deadline_ts,
+                    headroom_s=headroom_s, max_transient=rotate,
+                    abort=hedge_won.is_set,
+                )
+            except BaseException as e:
+                result["err"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=_primary, daemon=True).start()
+        delay = lh.hedge_delay(u, quantile=quantile)
+        hedged = False
+        while not done.wait(timeout=delay):
+            # the primary is in flight past the hedge delay: race the
+            # spool.  An uncommitted producer returns None — keep waiting
+            # and re-probe each interval (the producer commits its output
+            # independently of the broken consumer-side link).
+            hedged = True
+            blobs = _read_spool()
+            if blobs is not None:
+                hedge_won.set()  # loser canceled at its next attempt
+                HEDGED_FETCHES.labels("won").inc()
+                _fr.record(
+                    "hedged_fetch", node=self.url, task_id=t, producer=u,
+                    outcome="won", reason="hedge_delay",
+                )
+                return blobs
+        err = result.get("err")
+        if err is None:
+            if hedged:
+                HEDGED_FETCHES.labels("lost").inc()
+                _fr.record(
+                    "hedged_fetch", node=self.url, task_id=t, producer=u,
+                    outcome="lost",
+                )
+            return result["blobs"]
+        # the primary failed — rotation budget spent, deadline exhausted,
+        # or a permanent verdict: last-chance spool read before the typed
+        # error escapes to drive the coordinator's reproduction path
+        blobs = _read_spool()
+        if blobs is not None:
+            HEDGED_FETCHES.labels("won").inc()
+            _fr.record(
+                "hedged_fetch", node=self.url, task_id=t, producer=u,
+                outcome="won", reason="primary_failed",
+            )
+            return blobs
+        if hedged:
+            HEDGED_FETCHES.labels("failed").inc()
+        raise err
 
     # -------------------------------------------------------- buffer access
     def get_chunk(self, task_id: str, buffer_id: int, token: int, wait: float):
@@ -1170,6 +1294,12 @@ def _stream_fetch(
     ack: bool = True,
     backoff: Optional[Backoff] = None,
     node: str = "",
+    consumer: str = "",
+    health=None,
+    deadline_ts: float = 0.0,
+    headroom_s: float = 0.5,
+    max_transient: int = 0,
+    abort=None,
 ) -> list[bytes]:
     """Token-sequenced consumption of one producer buffer with acknowledge —
     the reference's HttpPageBufferClient loop (sendGetResults:355, token+ack
@@ -1181,14 +1311,85 @@ def _stream_fetch(
     Backoff and RESUME from the current token: already-fetched chunks are
     never re-appended, already-sent acks never un-free.  Only the backoff
     deadline escalates to a task-level failure.  Permanent errors (500 ==
-    producer task failed, 404/410 == buffer gone) raise immediately."""
+    producer task failed, 404/410 == buffer gone) raise immediately.
+
+    Partition tolerance (runtime/health.py): `consumer` rides the request
+    (query param + X-Trino-Consumer) so the producer can attribute the
+    link; `health` accrues per-link EWMA error/latency; `deadline_ts` is
+    the query's epoch deadline — a fetch with less than `headroom_s` of
+    budget left fails fast with the typed EXCHANGE_UNREACHABLE marker
+    instead of burning whole-query wall on blind retries; after
+    `max_transient` transient failures (or once the link breaker opens)
+    the loop rotates out with the same typed marker so the caller's hedge
+    path / the coordinator's reproduction takes over."""
     blobs: list[bytes] = []
     token = 0
     backoff = backoff or Backoff()
+    transients = 0
+
+    def _transient_verdict(detail: str) -> Optional[str]:
+        """After a transient failure: None == retry; otherwise the typed
+        message to raise (rotation / breaker / backoff exhaustion)."""
+        nonlocal transients
+        transients += 1
+        if health is not None:
+            health.record_failure(worker_url)
+        if max_transient and transients >= max_transient:
+            return (
+                f"EXCHANGE_UNREACHABLE:{task_id}: rotating to the hedge "
+                f"path after {transients} transient failures from "
+                f"{worker_url}: {detail}"
+            )
+        if backoff.failure():
+            return (
+                f"fetch {task_id}/{buffer_id}/{token} from {worker_url}: "
+                f"gave up after {backoff.failure_count} attempts: {detail}"
+            )
+        if health is not None and not health.is_usable(worker_url):
+            # the link breaker opened mid-retry: stop hammering a dead
+            # endpoint — the hedge path / reproduction takes over
+            return (
+                f"EXCHANGE_UNREACHABLE:{task_id}: link to {worker_url} "
+                f"graded DEAD after {transients} failures: {detail}"
+            )
+        return None
+
     while True:
-        url = f"{worker_url}/v1/task/{task_id}/results/{buffer_id}/{token}?wait=30"
+        if abort is not None and abort():
+            raise RuntimeError(
+                f"fetch {task_id}/{buffer_id}/{token} from {worker_url}: "
+                f"canceled (hedge path won)"
+            )
+        wait_s = 30.0
+        headers = {}
+        if consumer:
+            headers["X-Trino-Consumer"] = consumer
+        if deadline_ts:
+            remaining = deadline_ts - time.time()
+            if remaining <= headroom_s:
+                DEADLINE_ABORTS.inc()
+                raise RuntimeError(
+                    f"EXCHANGE_UNREACHABLE:{task_id}: exchange deadline "
+                    f"budget exhausted fetching buffer {buffer_id} token "
+                    f"{token} from {worker_url} ({remaining:.2f}s left)"
+                )
+            # each hop computes its remaining budget: the long-poll must
+            # return early enough for the typed failure to still beat the
+            # query deadline
+            wait_s = max(1.0, min(wait_s, remaining - headroom_s))
+            headers["X-Trino-Deadline"] = f"{deadline_ts:.3f}"
+        url = (
+            f"{worker_url}/v1/task/{task_id}/results/{buffer_id}/{token}"
+            f"?wait={wait_s:g}"
+        )
+        if consumer:
+            url += f"&consumer={quote(consumer, safe='')}"
+        t_req = time.monotonic()
         try:
-            with urllib.request.urlopen(url, timeout=60) as r:
+            with urllib.request.urlopen(
+                urllib.request.Request(url, headers=headers),
+                timeout=wait_s + 30.0,
+            ) as r:
                 body = r.read()
                 complete = r.headers.get("X-Complete") == "1"
                 no_data = r.headers.get("X-No-Data") == "1"
@@ -1199,13 +1400,9 @@ def _stream_fetch(
                     "exchange_retry", node=node, task_id=task_id,
                     producer=worker_url, token=token, http=e.code,
                 )
-                if backoff.failure():
-                    raise RuntimeError(
-                        f"fetch {task_id}/{buffer_id}/{token} from "
-                        f"{worker_url}: gave up after "
-                        f"{backoff.failure_count} attempts: "
-                        f"HTTP {e.code}: {detail}"
-                    )
+                msg = _transient_verdict(f"HTTP {e.code}: {detail}")
+                if msg is not None:
+                    raise RuntimeError(msg)
                 backoff.sleep()
                 continue
             # 500 = producer task failed, 404/410 = buffer gone: permanent
@@ -1218,11 +1415,17 @@ def _stream_fetch(
                 "exchange_retry", node=node, task_id=task_id,
                 producer=worker_url, token=token, error=str(e)[:120],
             )
-            if backoff.failure():
-                raise
+            msg = _transient_verdict(str(e))
+            if msg is not None:
+                raise RuntimeError(msg)
             backoff.sleep()
             continue
         backoff.success()
+        if health is not None and (complete or (body and not no_data)):
+            # only PRODUCTIVE responses feed the latency EWMA/history: an
+            # empty long-poll timeout measures the producer's compute
+            # pace, not the link, and would poison the hedge quantile
+            health.record_success(worker_url, time.monotonic() - t_req)
         if body and not no_data:
             # end-to-end page integrity: verify the crc32 frame BEFORE the
             # chunk is appended or acked.  A corrupted frame is transient —
@@ -1231,12 +1434,11 @@ def _stream_fetch(
             try:
                 unframe_chunk(body)
             except PageTransportError as e:
-                if backoff.failure():
-                    raise RuntimeError(
-                        f"fetch {task_id}/{buffer_id}/{token} from "
-                        f"{worker_url}: gave up after "
-                        f"{backoff.failure_count} attempts: {e}"
-                    )
+                # corruption is a link-quality signal too: it feeds the
+                # link EWMA and counts toward the rotation budget
+                msg = _transient_verdict(str(e))
+                if msg is not None:
+                    raise RuntimeError(msg)
                 backoff.sleep()
                 continue
             blobs.append(body)
@@ -1342,6 +1544,12 @@ def _make_handler(worker: Worker):
                             if worker.disk_pool is not None
                             else None
                         ),
+                        # consumer-side link grades (runtime/health.py):
+                        # the coordinator folds every worker's view into
+                        # the cluster link matrix — how an asymmetric
+                        # partition becomes visible without any worker
+                        # failing its heartbeat
+                        "links": worker.link_health.snapshot(),
                     }
                 ).encode()
                 return self._send(200, body, "application/json")
@@ -1375,12 +1583,29 @@ def _make_handler(worker: Worker):
                 if len(parts) >= 7 and parts[6] == "acknowledge":
                     worker.acknowledge(task_id, buffer_id, int(parts[5]))
                     return self._send(200, b"{}", "application/json")
+                # pairwise link faults (PARTITION/GRAY_SLOW/FLAKY_LINK):
+                # the requester's identity scopes the rule, so A→B can
+                # black-hole while coordinator→B and C→B serve normally
+                consumer = unquote(params.get("consumer", "")) or (
+                    self.headers.get("X-Trino-Consumer") or ""
+                )
+                if worker.fault_injector.link_fault(task_id, consumer) == "drop":
+                    return self._send(503, b"injected link drop")
                 if worker.fault_injector.drop_fetch(task_id):
                     # EXCHANGE_DROP: transient 503 — consumers must retry
                     # through Backoff and resume from their token
                     return self._send(503, b"injected exchange drop")
                 token = int(parts[5]) if len(parts) >= 6 else 0
                 wait = float(params.get("wait", "0"))
+                dl = self.headers.get("X-Trino-Deadline")
+                if dl:
+                    # coherent deadline propagation: never long-poll past
+                    # the query's remaining budget — the consumer must get
+                    # its answer (or lack of one) while it can still act
+                    try:
+                        wait = max(0.0, min(wait, float(dl) - time.time()))
+                    except ValueError:
+                        pass
                 code, body, headers = worker.get_chunk(task_id, buffer_id, token, wait)
                 if (
                     code == 200
@@ -1403,6 +1628,16 @@ def _make_handler(worker: Worker):
             parts = self.path.strip("/").split("/")
             if parts[:2] == ["v1", "task"]:
                 req = json.loads(body)
+                # the query deadline rides every task POST as a header
+                # (coherent deadline propagation) — fold it into the
+                # payload so the fetch loop computes remaining budget
+                # even when the dispatching coordinator predates the field
+                dl = self.headers.get("X-Trino-Deadline")
+                if dl and not req.get("deadline_ts"):
+                    try:
+                        req["deadline_ts"] = float(dl)
+                    except ValueError:
+                        pass
                 try:
                     worker.submit_task(req)
                 except DrainingError as e:
@@ -1458,6 +1693,7 @@ def _make_handler(worker: Worker):
                         count=req.get("count", 1),
                         probability=req.get("probability", 1.0),
                         seed=req.get("seed"),
+                        consumer=req.get("consumer", "*"),
                     )
                 except ValueError as e:
                     return self._send(400, str(e).encode())

@@ -21,7 +21,7 @@ from .runner import DistributedQueryRunner
 
 __all__ = [
     "ChaosRunner", "RECOVERABLE_MODES", "CORRUPTION_MODES", "COMPILE_MODES",
-    "SPLIT_MODES", "STORAGE_MODES", "WRITE_MODES",
+    "SPLIT_MODES", "STORAGE_MODES", "WRITE_MODES", "PARTITION_MODES",
 ]
 
 # modes that a retry_policy=TASK cluster must absorb without losing the
@@ -69,6 +69,20 @@ STORAGE_MODES = ("SPOOL_LOST", "DISK_FULL")
 # tuple — not folded into RECOVERABLE_MODES — so existing seeded schedules
 # replay identically.
 WRITE_MODES = ("COMMIT_CRASH", "WRITE_STALL")
+
+# opt-in: exchange-plane partition chaos (runtime/health.py + the hedged
+# fetch path in runtime/worker.py).  PARTITION black-holes a pairwise
+# (consumer -> producer) link with 503s — the consumer's LinkHealth must
+# grade it DEAD and the hedge path must serve the data from the spool;
+# GRAY_SLOW serves pages correctly but delay_ms late (latency-only gray
+# failure: no errors, the hedge race is the only mitigation); FLAKY_LINK
+# drops probabilistically (probability/seed).  All three scope by the
+# consumer= field on the rule and arm persistent (count=-1) so the link
+# stays broken for the whole drill.  A separate tuple — not folded into
+# RECOVERABLE_MODES — so existing seeded schedules replay identically;
+# pass modes=RECOVERABLE_MODES + PARTITION_MODES to arm it alongside the
+# rest (the cluster must run a spooled exchange for the hedge to win).
+PARTITION_MODES = ("PARTITION", "GRAY_SLOW", "FLAKY_LINK")
 
 # opt-in: split-plane chaos (runtime/splits.py).  SPLIT_LOST raises inside
 # one task's execution hook — under split_driven_scans a task IS one
@@ -130,6 +144,23 @@ class ChaosRunner:
                 ev["capacity_bytes"] = self.rng.choice(
                     (64 << 10, 256 << 10, 1 << 20)
                 )
+            if mode in ("PARTITION", "GRAY_SLOW", "FLAKY_LINK"):
+                # pairwise link fault: scope the rule to one OTHER worker's
+                # consumer identity and arm it persistent — a partition
+                # does not heal after N fetches, the hedge path must route
+                # around it for the rest of the query
+                others = [
+                    w.url
+                    for i, w in enumerate(self.runner.workers)
+                    if i != ev["worker_index"]
+                ]
+                ev["consumer"] = self.rng.choice(others) if others else "*"
+                ev["count"] = -1
+                if mode == "GRAY_SLOW":
+                    ev["delay_ms"] = self.rng.choice((200, 500, 1000))
+                if mode == "FLAKY_LINK":
+                    ev["probability"] = self.rng.choice((0.3, 0.5, 0.7))
+                    ev["seed"] = self.rng.randrange(1 << 30)
             self.runner.inject_task_failure(**ev)
             events.append(ev)
         self.schedule.append(events)

@@ -223,6 +223,7 @@ class DistributedQueryRunner:
         probability: float = 1.0,
         seed: int | None = None,
         capacity_bytes: int | None = None,
+        consumer: str | None = None,
     ) -> None:
         """Arm one rule of the worker's fault matrix (reference:
         TestingTrinoServer.injectTaskFailure, FailureInjector.java).  Modes:
@@ -230,8 +231,11 @@ class DistributedQueryRunner:
         delay_ms then run), EXCHANGE_DROP (503 the next `count` page
         fetches), CORRUPT (flip a byte in the next `count` served page
         frames), MEMORY_PRESSURE (shrink the worker's NodeMemoryPool to
-        `capacity_bytes` immediately).  probability<1 arms a seeded
-        probabilistic variant."""
+        `capacity_bytes` immediately), PARTITION / GRAY_SLOW / FLAKY_LINK
+        (pairwise link faults on this worker's served exchange fetches,
+        scoped by `consumer` — a worker-url prefix; "*" hits every
+        consumer).  probability<1 arms a seeded probabilistic variant;
+        count<0 arms a persistent rule that never exhausts."""
         w = self.workers[worker_index]
         body = {
             "task_id": task_id,
@@ -244,6 +248,8 @@ class DistributedQueryRunner:
             body["seed"] = seed
         if capacity_bytes is not None:
             body["capacity_bytes"] = capacity_bytes
+        if consumer is not None:
+            body["consumer"] = consumer
         req = urllib.request.Request(
             f"{w.url}/v1/inject_failure",
             data=json.dumps(body).encode(),
@@ -276,6 +282,40 @@ class DistributedQueryRunner:
         calls see the reduced capacity and park BLOCKED."""
         self.inject_task_failure(
             worker_index, mode="MEMORY_PRESSURE", capacity_bytes=capacity_bytes
+        )
+
+    def partition_link(
+        self, producer_index: int, consumer_index: int, count: int = -1
+    ) -> None:
+        """Black-hole the (consumer -> producer) exchange link: the
+        producer 503s every results fetch that identifies itself as coming
+        from that consumer — an ASYMMETRIC partition (heartbeats and every
+        other consumer's fetches keep working).  Persistent by default
+        (count=-1); the consumer's LinkHealth must grade the link DEAD and
+        reroute through the spool hedge path."""
+        self.inject_task_failure(
+            producer_index, mode="PARTITION", count=count,
+            consumer=self.workers[consumer_index].url,
+        )
+
+    def gray_slow(
+        self,
+        producer_index: int,
+        delay_ms: int,
+        consumer_index: int | None = None,
+        count: int = -1,
+    ) -> None:
+        """Make a producer serve exchange pages delay_ms late WITHOUT any
+        error — the latency-only gray failure the link scorer must catch
+        (SUSPECT on the latency ratio) and the hedge race must mitigate.
+        Scopes to one consumer when given, otherwise to every fetcher."""
+        self.inject_task_failure(
+            producer_index, mode="GRAY_SLOW", delay_ms=delay_ms, count=count,
+            consumer=(
+                self.workers[consumer_index].url
+                if consumer_index is not None
+                else "*"
+            ),
         )
 
     def disk_full(self, worker_index: int, capacity_bytes: int) -> None:

@@ -33,6 +33,14 @@ Design constraints:
 Config: `flightrecorder.ring-size` / `flightrecorder.enabled`
 (runtime/config.py) feed `configure()`; `enabled=false` turns `record()`
 into a near-no-op (one attribute read).
+
+Partition-tolerance events (runtime/health.py, runtime/worker.py):
+`link_state` marks a (consumer, producer) exchange link changing grade —
+emitted by the consumer when its LinkHealth scorer regrades, and by the
+coordinator when a heartbeat-folded matrix row changes, so a post-mortem
+can line the two vantages up; `hedged_fetch` records each hedge race's
+outcome (won / lost / failed) with the reason the hedge launched
+(hedge_delay, breaker_open, primary_failed).
 """
 
 from __future__ import annotations
