@@ -520,6 +520,110 @@ def _bench_observability(deadline) -> dict:
     return out
 
 
+def _bench_observatory(deadline) -> dict:
+    """Telemetry-observatory harness (ISSUE 20), two halves:
+
+    1. sampler overhead — warm p50 for q01/q06 with the time-series
+       sampler thread running vs stopped, paired-interleaved like the
+       flight-recorder harness; acceptance budget <5% warm-p50 overhead.
+    2. roofline consistency — the live per-query figure (cost_analysis
+       bytes_accessed x dispatches / measured execute wall, the same
+       join the coordinator performs) must land within 2x of the same
+       bytes over a dedicated steady-state device-wall measurement.
+       Both sides use the profiler's byte totals, so the check isolates
+       the WALL measurement (live in-band timing vs pipelined
+       steady_state_time) — the part the observatory could get wrong."""
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.runtime.engine import Engine
+    from trino_tpu.utils import timeseries as ts
+    from trino_tpu.utils.profiler import PROFILER
+
+    sf = float(os.environ.get("BENCH_OBS_SF", "0.1"))
+    iters = int(os.environ.get("BENCH_OBS_ITERS", "9"))
+    eng = Engine()
+    eng.register_catalog("tpch", TpchConnector(sf))
+    out = {"sf": sf, "iters": iters, "budget_pct": 5.0, "queries": {}}
+
+    sampler = ts.Sampler(
+        "bench-observatory",
+        {"cpu_s": ts.cpu_seconds, "rss_bytes": ts.current_rss_bytes},
+        deltas={"cpu_s"},
+    )
+
+    def paired_p50(plan) -> tuple:
+        # same interleave as _bench_observability: one off-run and one
+        # on-run per iteration so host drift lands on both sides
+        offs: list = []
+        ons: list = []
+        for _ in range(iters):
+            sampler.stop()
+            t0 = time.perf_counter()
+            eng.executor.execute(plan)
+            offs.append(time.perf_counter() - t0)
+            sampler.start()
+            t0 = time.perf_counter()
+            eng.executor.execute(plan)
+            ons.append(time.perf_counter() - t0)
+            if deadline.remaining() < 5:
+                break
+        return (sorted(offs)[len(offs) // 2], sorted(ons)[len(ons) // 2])
+
+    def live_figures() -> tuple:
+        # join the executor's per-signature dispatch ledger with the
+        # profiler's cost figures — the coordinator's roofline math.
+        # Returns (bytes moved by the LAST execute() call, its summed
+        # dispatch wall).
+        byts = 0.0
+        exec_s = 0.0
+        for sig, ev in (getattr(eng.executor, "execute_events", None)
+                        or {}).items():
+            prof = PROFILER.snapshot(sig) or {}
+            ba = prof.get("bytes_accessed")
+            if ba and ev.get("executes") and ev.get("execute_s"):
+                byts += float(ba) * ev["executes"]
+                exec_s += ev["execute_s"]
+        return byts, exec_s
+
+    try:
+        for name in ("q01", "q06"):
+            if deadline.remaining() < 30:
+                out["queries"][name] = {"skipped": "deadline"}
+                continue
+            plan = eng.plan(QUERIES[name])
+            eng.executor.execute(plan)  # cold: generation + upload + compile
+            eng.executor.execute(plan)  # adaptive-compaction recompile
+            eng.executor.execute(plan)  # settle before the timed pairs
+            off, on = paired_p50(plan)
+            pct = 100.0 * (on - off) / off if off > 0 else 0.0
+            entry = {
+                "warm_p50_off_s": round(off, 4),
+                "warm_p50_on_s": round(on, 4),
+                "regression_pct": round(pct, 2),
+                "within_budget": pct < 5.0,
+            }
+            byts, exec_s = live_figures()
+            if byts > 0 and exec_s > 0 and hasattr(
+                eng.executor, "steady_state_time"
+            ):
+                live = byts / exec_s / 1e9
+                dev_s = eng.executor.steady_state_time(plan, iters=3)
+                bench_gbps = byts / dev_s / 1e9 if dev_s > 0 else 0.0
+                ratio = live / bench_gbps if bench_gbps > 0 else 0.0
+                entry["live_device_gb_per_sec"] = round(live, 3)
+                entry["bench_device_gb_per_sec"] = round(bench_gbps, 3)
+                entry["live_vs_bench_ratio"] = round(ratio, 3)
+                entry["within_2x"] = 0.5 <= ratio <= 2.0
+            out["queries"][name] = entry
+    finally:
+        sampler.stop()
+    out["within_budget"] = all(
+        q.get("within_budget", True)
+        for q in out["queries"].values()
+        if isinstance(q, dict)
+    )
+    return out
+
+
 def _bench_prepared(deadline) -> dict:
     """Serving fast path (runtime/fastpath.py): PREPARE once, EXECUTE with a
     different parameter every time, against the same workload issued the old
@@ -1006,6 +1110,14 @@ def main() -> None:
             result["observability"] = _bench_observability(deadline)
         except Exception as e:
             result["observability"] = {"error": str(e)[:200]}
+        emit()
+
+    # ---- telemetry observatory: sampler overhead + roofline check -------
+    if os.environ.get("BENCH_OBSERVATORY", "1") != "0" and deadline.remaining() > 60:
+        try:
+            result["observatory"] = _bench_observatory(deadline)
+        except Exception as e:
+            result["observatory"] = {"error": str(e)[:200]}
         emit()
 
     # ---- serving fast path: PREPARE/EXECUTE vs ad-hoc text (ISSUE 10) ----

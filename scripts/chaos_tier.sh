@@ -59,6 +59,10 @@
 #              coordinator link matrix grades the impaired link while
 #              BOTH endpoints stay un-quarantined; plus the LinkHealth
 #              unit suite (EWMA grading, half-open probe, hedge quantile)
+# Observatory chaos (tests/test_timeseries.py):
+#   observe GRAY_SLOW + MEMORY_PRESSURE drill — memory-pool reserved
+#           must rise then fall on the time-series plane and the
+#           post-mortem timeseries slice must cover the window
 # Write-plane chaos (tests/test_write_txn.py):
 #   write   COMMIT_CRASH at every phase boundary of the staged-commit
 #           protocol (intent / commit / ack) — the target table must be
@@ -145,6 +149,17 @@ case "${1:-}" in
     # sentinel slow-run drill and the bundle-survives-restart drill
     exec env JAX_PLATFORMS=cpu python -m pytest tests/test_flightrecorder.py -q \
         -p no:cacheprovider "$@"
+    ;;
+  observe)
+    shift
+    # telemetry-observatory chaos (tests/test_timeseries.py): GRAY_SLOW
+    # exchange pages stretch the window while tasks hold their memory
+    # reservations and MEMORY_PRESSURE shrinks one pool mid-run — the
+    # time-series plane must show memory-pool reserved RISING then
+    # FALLING (and the capacity drop), and the post-mortem bundle's
+    # timeseries slice must cover the pressure window
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_timeseries.py -q \
+        -k "observe_drill" -p no:cacheprovider "$@"
     ;;
   cache)
     shift

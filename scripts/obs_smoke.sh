@@ -20,7 +20,12 @@
 # partition-tolerance plane — the cluster link matrix served on
 # /v1/info (consumer -> producer -> grade) and a nonzero
 # trino_tpu_hedged_fetches_total{outcome="won"} under an injected
-# GRAY_SLOW producer (the hedged spool fetch actually racing).
+# GRAY_SLOW producer (the hedged spool fetch actually racing), and the
+# telemetry observatory — GET /v1/timeseries on both roles (federated
+# cluster view on the coordinator, own-lane-only on workers) with a
+# nonzero cpu series, `-- roofline:` / `-- device bandwidth:` /
+# `-- exchange:` footers on the distributed EXPLAIN ANALYZE, and moving
+# trino_tpu_exchange_bytes_total{direction} counters.
 #
 # Fast enough to run on every runtime/ or exec/ change; the same checks
 # run under the tier-1 gate via tests/test_obs_plane.py.
@@ -137,6 +142,57 @@ try:
     failures = mlint.lint(targets, "README.md")
     assert not failures, f"metrics lint: {failures}"
     print(f"metrics_lint: {len(targets)} targets clean")
+
+    # telemetry observatory (utils/timeseries.py + utils/roofline.py):
+    # the distributed EXPLAIN ANALYZE must carry the roofline attribution
+    # footers, GET /v1/timeseries must answer on BOTH roles (coordinator
+    # federated, worker own-lane-only) with a nonzero cpu series, and the
+    # per-link exchange accounting must move the direction-labelled
+    # exchange byte counters on the workers
+    rooflines = [ln for ln in text.splitlines() if ln.startswith("-- roofline:")]
+    assert rooflines, f"expected roofline footers:\n{text[-800:]}"
+    assert any("% of" in ln for ln in rooflines), rooflines
+    devlines = [ln for ln in text.splitlines()
+                if ln.startswith("-- device bandwidth:")]
+    assert devlines, "expected the query-wide device bandwidth footer"
+    exlines = [ln for ln in text.splitlines() if ln.startswith("-- exchange:")]
+    assert exlines, "expected per-stage exchange throughput footers"
+    print(f"roofline: {rooflines[0]}")
+    print(f"exchange: {exlines[0]}")
+
+    import time as _t
+    want_nodes = {base} | {w.url for w in runner.workers}
+    ts_deadline = _t.monotonic() + 15  # default 1 s ticks: allow a few
+    while _t.monotonic() < ts_deadline:
+        tsp = json.loads(get(base + "/v1/timeseries"))
+        nodes = tsp.get("nodes") or {}
+        if want_nodes <= set(nodes) and all(
+            "cpu_s" in nodes[n] for n in want_nodes
+        ):
+            break
+        _t.sleep(0.5)
+    assert want_nodes <= set(nodes), (
+        f"coordinator never federated all lanes: {sorted(nodes)}"
+    )
+    assert sum(v for _, v in nodes[base]["cpu_s"]) > 0, (
+        "coordinator cpu_s series is all-zero"
+    )
+    wts = json.loads(get(runner.workers[0].url + "/v1/timeseries"))
+    assert wts["node"] == runner.workers[0].url
+    assert "cpu_s" in (wts.get("series") or {}), "worker lane missing cpu_s"
+    print(f"/v1/timeseries: {len(nodes)} node lanes federated, "
+          f"worker serves its own lane ok")
+
+    exch_vals = []
+    for w in runner.workers:
+        for ln in get(f"{w.url}/metrics").splitlines():
+            if ln.startswith("trino_tpu_exchange_bytes_total{"):
+                exch_vals.append(float(ln.split()[-1]))
+    assert exch_vals and max(exch_vals) > 0, (
+        f"exchange byte counters did not move: {exch_vals}"
+    )
+    print(f"exchange_bytes_total: {len(exch_vals)} samples, "
+          f"max {max(exch_vals):.0f} B")
 
     info = json.loads(get(f"{base}/v1/query/{qid}"))
     assert info["stage_count"] >= 2 and info["cpu_ms"] > 0

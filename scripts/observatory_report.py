@@ -1,0 +1,200 @@
+#!/usr/bin/env python3
+"""Render the cluster telemetry observatory as text: per-node utilization
+timelines (the /v1/timeseries federated view) plus the per-query roofline
+table (achieved GB/s / GFLOP/s / %-of-roofline per executed signature).
+
+Two sources:
+
+- a LIVE coordinator URL — fetches GET /v1/timeseries for the cluster
+  lanes and GET /v1/query?limit=N for recent queries' roofline figures
+- a SAVED post-mortem bundle (bundle.jsonl) — reads the embedded
+  ``type: timeseries`` slice and the bundle's QueryInfo
+
+Usage:
+    python scripts/observatory_report.py http://coordinator:8080
+    python scripts/observatory_report.py <spool>/postmortem_<qid>/bundle.jsonl
+    ... [--series cpu_s,rss_bytes] [--since SECS_AGO] [--width 60]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+# sparkline glyphs, lowest to highest
+_TICKS = " .:-=+*#%@"
+
+
+def _spark(points: list, width: int) -> str:
+    """Values -> a fixed-width character sparkline (last `width` points)."""
+    vals = [float(p[1]) for p in points][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _TICKS[min(len(_TICKS) - 1, int((v - lo) / span * (len(_TICKS) - 1)))]
+        for v in vals
+    )
+
+
+def _fmt_val(series: str, v: float) -> str:
+    if series.endswith("_bytes"):
+        return f"{v / (1 << 20):.1f}M" if v >= 1 << 20 else f"{v:.0f}B"
+    if series == "cpu_s":
+        return f"{v:.2f}s"
+    return f"{v:g}"
+
+
+def render_timeline(nodes: dict, series: list | None, width: int) -> list[str]:
+    """{node: {series: [[ts, v], ...]}} -> per-node sparkline lanes."""
+    out = []
+    for node in sorted(nodes):
+        out.append(f"node {node}")
+        lanes = nodes[node] or {}
+        for name in sorted(lanes):
+            if series and name not in series:
+                continue
+            pts = lanes[name] or []
+            if not pts:
+                continue
+            last = _fmt_val(name, float(pts[-1][1]))
+            peak = _fmt_val(name, max(float(p[1]) for p in pts))
+            out.append(
+                f"  {name:<22} |{_spark(pts, width):<{width}}| "
+                f"last {last}, peak {peak}, n={len(pts)}"
+            )
+        out.append("")
+    return out
+
+
+def render_roofline(queries: list[dict]) -> list[str]:
+    """Recent queries' roofline tables (QueryInfo roofline + exchange)."""
+    out = []
+    for q in queries:
+        roof = q.get("roofline") or {}
+        sigs = roof.get("signatures") or []
+        if not sigs and q.get("device_gb_per_sec") is None:
+            continue
+        dev = roof.get("device") or {}
+        hdr = f"query {q.get('query_id', '?')}"
+        if q.get("device_gb_per_sec") is not None:
+            hdr += f"  device {q['device_gb_per_sec']:.3f} GB/s"
+        if dev.get("hbm_gbps"):
+            hdr += (
+                f"  (roofline {dev['hbm_gbps']:g} GB/s"
+                f" {dev.get('device_kind', '?')}, {dev.get('source', '?')})"
+            )
+        out.append(hdr)
+        if sigs:
+            out.append(
+                f"  {'signature':<32} {'exec':>5} {'ms':>9} "
+                f"{'GFLOP/s':>9} {'GB/s':>8} {'%roof':>6}"
+            )
+            for s in sigs:
+                out.append(
+                    f"  {s.get('signature', '?'):<32} "
+                    f"{s.get('executes', 0):>5} "
+                    f"{s.get('execute_ms', 0.0):>9.1f} "
+                    f"{s.get('gflop_per_sec', 0.0):>9.3f} "
+                    f"{s.get('gb_per_sec', 0.0):>8.3f} "
+                    f"{s.get('pct_of_roofline', 0.0):>5.1f}%"
+                )
+        for st in q.get("exchange") or []:
+            if not st.get("bytes"):
+                continue
+            rate = st.get("gb_per_sec")
+            out.append(
+                f"  exchange stage {st.get('stage_id')}: "
+                f"{st.get('bytes', 0)} B / {st.get('wall_ms', 0.0):.1f} ms"
+                + (f" = {rate:.3f} GB/s" if rate is not None else "")
+                + f" over {len(st.get('links') or {})} link(s)"
+            )
+        out.append("")
+    return out
+
+
+def from_live(base: str, since: float | None, series: list | None) -> tuple:
+    url = base.rstrip("/") + "/v1/timeseries"
+    q = []
+    if since is not None:
+        q.append(f"since={time.time() - since}")
+    if series:
+        q.append("series=" + ",".join(series))
+    if q:
+        url += "?" + "&".join(q)
+    with urllib.request.urlopen(url, timeout=10) as r:
+        nodes = (json.loads(r.read()) or {}).get("nodes") or {}
+    with urllib.request.urlopen(
+        base.rstrip("/") + "/v1/query?limit=10", timeout=10
+    ) as r:
+        listing = json.loads(r.read())
+    queries = listing if isinstance(listing, list) else (
+        listing.get("queries") or []
+    )
+    # the listing may be shallow — fetch full records for roofline fields
+    full = []
+    for q_ in queries:
+        qid = q_.get("query_id") if isinstance(q_, dict) else None
+        if qid and "roofline" not in (q_ or {}):
+            try:
+                with urllib.request.urlopen(
+                    base.rstrip("/") + f"/v1/query/{qid}", timeout=10
+                ) as r:
+                    full.append(json.loads(r.read()))
+                continue
+            except OSError:
+                pass
+        full.append(q_)
+    return nodes, full
+
+
+def from_bundle(path: str) -> tuple:
+    nodes, queries = {}, []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "timeseries":
+                nodes = rec.get("nodes") or {}
+            elif rec.get("type") == "query_info":
+                queries.append(rec)
+    return nodes, queries
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="observatory_report.py")
+    ap.add_argument("source", help="coordinator URL or bundle.jsonl path")
+    ap.add_argument("--series", default=None,
+                    help="comma-separated series filter (e.g. cpu_s,rss_bytes)")
+    ap.add_argument("--since", type=float, default=None,
+                    help="live mode: only points newer than SECS ago")
+    ap.add_argument("--width", type=int, default=60,
+                    help="sparkline width in characters")
+    args = ap.parse_args(argv)
+    series = [s for s in (args.series or "").split(",") if s] or None
+
+    if args.source.startswith(("http://", "https://")):
+        nodes, queries = from_live(args.source, args.since, series)
+    else:
+        nodes, queries = from_bundle(args.source)
+
+    lines = ["== cluster timeline =="]
+    lines += render_timeline(nodes, series, max(10, args.width))
+    lines.append("== roofline attribution ==")
+    roof = render_roofline(queries)
+    lines += roof or ["(no queries with roofline figures)"]
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

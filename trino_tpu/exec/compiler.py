@@ -155,6 +155,12 @@ class LocalExecutor:
         self.compile_events: list[dict] = []
         self.last_compile_ms = 0.0
         self.last_execute_ms = 0.0
+        # per-signature execute ledger for the LAST execute() call:
+        # sig -> {executes, fallback_executes, execute_s}.  Unlike
+        # compile_events (misses only) this names every dispatched
+        # signature — warm runs included — so the roofline plane can
+        # join it with the profiler's flops/bytes per signature
+        self.execute_events: dict[str, dict] = {}
         # compile resilience plane (exec/compilesvc.py): bound how long a
         # query blocks on XLA compile.  budget 0 == wait for the compile
         # (bounded only by the deadline); deadline 0 == no deadline.  When
@@ -316,6 +322,7 @@ class LocalExecutor:
         t0 = _time.perf_counter()
         self.last_compile_ms = 0.0  # accumulated by _run's jit-cache misses
         self.last_execute_ms = 0.0
+        self.execute_events = {}
         nodes = _node_ids(plan)
         inputs = {}
         for i, n in nodes.items():
@@ -784,7 +791,7 @@ class LocalExecutor:
                     out_page, required = _trace_plan(
                         plan, inputs, dict(caps), collect_stats=collect
                     )
-                PROFILER.record_execute(
+                self._note_execute(
                     sig, _time.perf_counter() - t0, fallback=True
                 )
                 return out_page, {k: int(v) for k, v in required.items()}
@@ -804,9 +811,33 @@ class LocalExecutor:
             self._jit_cache[cache_key] = (fn, holder, sig)
             out_page, packed = fn(inputs, params)
         vals = np.asarray(packed)  # ONE device->host transfer
-        PROFILER.record_execute(sig, _time.perf_counter() - t0)
+        self._note_execute(sig, _time.perf_counter() - t0)
         required = dict(zip(holder["keys"], vals.tolist()))
         return out_page, required
+
+    def _note_execute(
+        self, sig: str, seconds: float, fallback: bool = False
+    ) -> None:
+        """Record one dispatch in both the process-global profiler and
+        this executor's per-call ledger (the roofline plane's join key)."""
+        from ..utils.profiler import PROFILER
+
+        PROFILER.record_execute(sig, seconds, fallback=fallback)
+        e = self.execute_events.setdefault(
+            sig, {"executes": 0, "fallback_executes": 0,
+                  "execute_s": 0.0, "fallback_execute_s": 0.0}
+        )
+        # fallback (eager) dispatch wall is kept apart: cost_analysis()
+        # flops/bytes describe the COMPILED program, so folding eager wall
+        # into execute_s would understate achieved bandwidth
+        if fallback:
+            e["fallback_executes"] += 1
+            e["fallback_execute_s"] = round(
+                e["fallback_execute_s"] + float(seconds), 6
+            )
+        else:
+            e["executes"] += 1
+            e["execute_s"] = round(e["execute_s"] + float(seconds), 6)
 
 
 def _make_call(plan: PlanNode, caps: dict[int, int], collect: bool):

@@ -43,6 +43,14 @@ Recognized config.properties keys:
     flightrecorder.ring-size=4096   events held in the ring; overflow drops
                                     the oldest (counted in
                                     trino_tpu_flightrecorder_dropped_total)
+    timeseries.enabled=true         per-node utilization sampler + ring TSDB
+                                    (utils/timeseries.py) served at
+                                    GET /v1/timeseries on every node and
+                                    federated by the coordinator
+    timeseries.ring-size=512        points held per (node, series) lane;
+                                    overflow drops the oldest (counted in
+                                    trino_tpu_timeseries_points_dropped_total)
+    timeseries.sample-interval-s=1  seconds between sampler ticks
 
 Connector factories (connector.name=):
     tpch (tpch.scale=), tpcds (tpcds.scale=), memory, blackhole,
@@ -64,6 +72,7 @@ __all__ = [
     "NodeConfig",
     "load_node_config",
     "apply_flightrecorder_config",
+    "apply_timeseries_config",
 ]
 
 
@@ -176,6 +185,15 @@ class NodeConfig:
         self.flightrecorder_ring_size = int(
             props.get("flightrecorder.ring-size", "4096")
         )
+        # time-series plane (utils/timeseries.py) — applied to the
+        # process-global store at node boot
+        self.timeseries_enabled = (
+            props.get("timeseries.enabled", "true").lower() == "true"
+        )
+        self.timeseries_ring_size = int(props.get("timeseries.ring-size", "512"))
+        self.timeseries_sample_interval_s = float(
+            props.get("timeseries.sample-interval-s", "1")
+        )
 
 
 def apply_flightrecorder_config(cfg: "NodeConfig") -> None:
@@ -185,6 +203,18 @@ def apply_flightrecorder_config(cfg: "NodeConfig") -> None:
 
     _fr.configure(
         ring_size=cfg.flightrecorder_ring_size, enabled=cfg.flightrecorder_enabled
+    )
+
+
+def apply_timeseries_config(cfg: "NodeConfig") -> None:
+    """Push the node's time-series keys onto the process-global store
+    (server boot path; tests configure the store directly)."""
+    from ..utils import timeseries as _ts
+
+    _ts.configure(
+        ring_size=cfg.timeseries_ring_size,
+        enabled=cfg.timeseries_enabled,
+        sample_interval_s=cfg.timeseries_sample_interval_s,
     )
 
 

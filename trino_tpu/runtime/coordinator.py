@@ -45,6 +45,8 @@ from ..plan.planner import Planner
 from ..plan.serde import _encode, plan_to_json
 from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
+from ..utils import roofline as _roofline
+from ..utils import timeseries as _ts
 from ..utils.tracing import Tracer, add_exporters_from_env, traceparent
 from .events import EventListenerManager, QueryEvent
 from .failure import (
@@ -97,6 +99,14 @@ def _json_default(o):
     )
 
 
+def _svc_compile_inflight() -> int:
+    """Compiles running/queued in the process-global compile service —
+    the sampler's `compile_inflight` lane."""
+    from ..exec.compilesvc import SERVICE
+
+    return int(SERVICE.stats()["inflight"])
+
+
 class _WorkerInfo:
     def __init__(self, url: str):
         self.url = url
@@ -113,6 +123,10 @@ class _WorkerInfo:
         # (runtime/health.py snapshot() shipped on /v1/info) — one ROW of
         # the cluster link matrix: {producer_url: {state, error_ewma, ...}}
         self.links: dict = {}
+        # residency from the last heartbeat (observatory plane): CURRENT
+        # rss (can fall after revocation) and the lifetime high-water mark
+        self.rss_bytes: Optional[int] = None
+        self.peak_rss_bytes: Optional[int] = None
 
 
 class Coordinator:
@@ -232,7 +246,7 @@ class Coordinator:
             "trino_tpu_query_anomalies_total",
             "Typed anomalies the sentinel attached to finished queries, by "
             "anomaly kind (SLOW_VS_BASELINE / SPILL_REGRESSION / "
-            "RETRY_STORM / COMPILE_STORM)",
+            "RETRY_STORM / COMPILE_STORM / BANDWIDTH_REGRESSION)",
             ("kind",),
         )
         self._m_postmortems = self.metrics.counter(
@@ -356,14 +370,42 @@ class Coordinator:
             # learn where adopted queries answer from the fleet dir alone
             self.fleet.url = self.url
             self.fleet.acquire()
+        # coordinator lane of the per-node time-series plane
+        # (utils/timeseries.py): same vocabulary as the workers, minus the
+        # pools this role doesn't own
+        self.sampler = _ts.Sampler(
+            self.url,
+            {
+                "cpu_s": _ts.cpu_seconds,
+                "rss_bytes": _ts.current_rss_bytes,
+                "split_backlog": self._live_query_count,
+                "compile_inflight": _svc_compile_inflight,
+                "links_impaired": self._links_impaired_count,
+            },
+            deltas={"cpu_s"},
+        )
         self._threads = [
             threading.Thread(target=self.httpd.serve_forever, daemon=True),
             threading.Thread(target=self._heartbeat_loop, daemon=True),
         ]
 
+    def _live_query_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.queries.values() if not r["sm"].done)
+
+    def _links_impaired_count(self) -> int:
+        with self._lock:
+            return sum(
+                1
+                for w in self.workers.values()
+                for cell in (w.links or {}).values()
+                if cell.get("state") != "HEALTHY"
+            )
+
     def start(self) -> "Coordinator":
         for t in self._threads:
             t.start()
+        self.sampler.start()  # no-op when the timeseries plane is disabled
         if any(
             rec.get("resume_state") is not None
             for rec in self.queries.values()
@@ -414,6 +456,7 @@ class Coordinator:
 
     def stop(self) -> None:
         self._hb_stop.set()
+        self.sampler.stop()
         self.httpd.shutdown()
         # release the port: a replacement coordinator must be able to bind
         # the same address (clients re-attach to an unchanged nextUri)
@@ -434,6 +477,7 @@ class Coordinator:
         unterminated journal) is left behind here too."""
         self._killed = True
         self._hb_stop.set()
+        self.sampler.stop()
         try:
             self.httpd.shutdown()
             self.httpd.server_close()
@@ -867,6 +911,10 @@ class Coordinator:
                     w.mem = info.get("memory_pool")
                     if w.mem:
                         mem_snapshots[w.url] = w.mem
+                    # residency rides the heartbeat (observatory plane):
+                    # current rss can FALL after revocation; peak cannot
+                    w.rss_bytes = info.get("rss_bytes")
+                    w.peak_rss_bytes = info.get("peak_rss_bytes")
                     # disk-pool snapshots ride the same heartbeat: the GC
                     # tick below escalates spool reclaim under pressure
                     w.disk = info.get("disk_pool")
@@ -1568,6 +1616,22 @@ class Coordinator:
                 "kind": "COMPILE_STORM", "compile_count": compiles,
                 "baseline_p50": cp50,
             })
+        # bandwidth regression: INVERTED comparison — low achieved GB/s
+        # is the failure.  The floor guard keeps noise-band signatures
+        # (tiny programs where a scheduler hiccup halves "bandwidth")
+        # from flagging; a run with no roofline figure stays silent.
+        gbps = float(qi.get("device_gb_per_sec") or 0.0)
+        bp50 = float(base.get("gb_per_sec_p50") or 0.0)
+        bfac = float(self.session.get("anomaly_bandwidth_factor") or 2.0)
+        bfloor = float(
+            self.session.get("anomaly_bandwidth_min_gb_per_sec") or 0.0
+        )
+        if gbps > 0 and bp50 > 0 and bp50 >= bfloor and gbps < bp50 / bfac:
+            anomalies.append({
+                "kind": "BANDWIDTH_REGRESSION", "gb_per_sec": gbps,
+                "baseline_p50": bp50,
+                "factor": round(bp50 / gbps, 2),
+            })
         record["anomalies"] = qi["anomalies"] = anomalies
         for a in anomalies:
             self._m_anomalies.labels(a["kind"]).inc()
@@ -1576,6 +1640,40 @@ class Coordinator:
                 anomaly=a["kind"],
                 **{k: v for k, v in a.items() if k != "kind"},
             )
+
+    # ------------------------------------------------ federated time series
+    def _federated_timeseries(
+        self,
+        since: Optional[float] = None,
+        series: Optional[list[str]] = None,
+    ) -> dict:
+        """Cluster utilization view: ``{node: {series: [[ts, v], ...]}}``
+        — this process's lanes plus every alive worker's own lane fetched
+        over ``GET /v1/timeseries``.  In-process test clusters share one
+        store, so a worker's lane is usually already local; the fetch
+        covers the separate-process deployment and is skipped when the
+        lane is present (the shared ring would answer identically)."""
+        nodes = _ts.snapshot(since=since, series=series)
+        q = []
+        if since is not None:
+            q.append(f"since={since}")
+        if series:
+            q.append("series=" + ",".join(series))
+        qs = ("?" + "&".join(q)) if q else ""
+        for wurl in self.alive_workers():
+            if wurl in nodes:
+                continue
+            try:
+                with urllib.request.urlopen(
+                    f"{wurl}/v1/timeseries{qs}", timeout=3
+                ) as r:
+                    payload = json.loads(r.read())
+            except Exception:
+                continue  # a dead worker's lane is simply absent
+            lanes = payload.get("series") or {}
+            if lanes:
+                nodes[payload.get("node") or wurl] = lanes
+        return nodes
 
     # ---------------------------------------------------- post-mortem bundle
     def _postmortem_dir(self) -> str:
@@ -1723,6 +1821,24 @@ class Coordinator:
         }
         lines = [json.dumps(header, default=str)]
         lines.append(json.dumps(dict(qi, type="query_info"), default=str))
+        # observatory slice: every node's utilization lanes over the query
+        # window (padded one sample either side so the reader sees the
+        # before/after level, not just the spike) — one line, base budget
+        try:
+            t0 = (sm.created_at if sm is not None
+                  else qi.get("created_ts")) or None
+            t1 = (sm.finished_at if sm is not None
+                  else qi.get("finished_ts")) or time.time()
+            pad = _ts.STORE.sample_interval_s * 2
+            lines.append(json.dumps({
+                "type": "timeseries",
+                "window": [t0, t1],
+                "nodes": self._federated_timeseries(
+                    since=(t0 - pad) if t0 else None
+                ),
+            }, default=str))
+        except Exception:
+            traceback.print_exc()
         for jrec in self._journal_lines(qid):
             lines.append(json.dumps(dict(jrec, type="journal"), default=str))
         ev_lines = [
@@ -2833,6 +2949,27 @@ class Coordinator:
         compile_sigs: dict[str, dict] = {}
         fallback_execs = 0
         fallback_reasons: dict[str, int] = {}
+        # roofline plane: sig -> {executes, execute_s, flops,
+        # bytes_accessed} merged across every task's dispatch ledger —
+        # unlike compile_sigs this names warm (cache-hit) signatures too
+        exec_sigs: dict[str, dict] = {}
+        # exchange plane: stage_id -> {url: {bytes, wall_ms, fetches}}
+        stage_links: dict[int, dict] = {}
+
+        def merge_execute_events(evmap) -> None:
+            for sig, ev in (evmap or {}).items():
+                agg = exec_sigs.setdefault(
+                    sig,
+                    {"executes": 0, "execute_s": 0.0,
+                     "flops": None, "bytes_accessed": None},
+                )
+                agg["executes"] += int(ev.get("executes") or 0)
+                agg["execute_s"] = round(
+                    agg["execute_s"] + float(ev.get("execute_s") or 0.0), 6
+                )
+                for k in ("flops", "bytes_accessed"):
+                    if ev.get(k) is not None:
+                        agg[k] = float(ev[k])
 
         def merge_compile_events(events) -> None:
             nonlocal fallback_execs
@@ -2887,6 +3024,21 @@ class Coordinator:
                 merge_compile_events(
                     getattr(root_executor, "compile_events", None)
                 )
+                # the root fragment executes in THIS process: join its
+                # dispatch ledger with the local profiler's cost figures
+                from ..utils.profiler import PROFILER as _prof
+
+                root_evs = {}
+                for sig, ev in (
+                    getattr(root_executor, "execute_events", None) or {}
+                ).items():
+                    rec = dict(ev)
+                    p = _prof.snapshot(sig) or {}
+                    for k in ("flops", "bytes_accessed"):
+                        if p.get(k) is not None:
+                            rec[k] = p[k]
+                    root_evs[sig] = rec
+                merge_execute_events(root_evs)
             else:
                 for (url, task_id) in task_urls.get(f.id, []):
                     if url == SPOOL_URL:
@@ -2914,6 +3066,17 @@ class Coordinator:
                     exchange_wait_ms += float(st.get("exchange_wait_ms") or 0.0)
                     spill_ms += float(st.get("spill_ms") or 0.0)
                     merge_compile_events(st.get("compile_events"))
+                    merge_execute_events(st.get("execute_events"))
+                    for u, ls in (st.get("exchange_links") or {}).items():
+                        agg = stage_links.setdefault(f.id, {}).setdefault(
+                            u, {"bytes": 0, "wall_ms": 0.0, "fetches": 0}
+                        )
+                        agg["bytes"] += int(ls.get("bytes") or 0)
+                        agg["wall_ms"] = round(
+                            agg["wall_ms"] + float(ls.get("wall_ms") or 0.0),
+                            3,
+                        )
+                        agg["fetches"] += int(ls.get("fetches") or 0)
                     peak_mem = max(
                         peak_mem,
                         int(st.get("output_bytes") or 0),
@@ -2955,6 +3118,64 @@ class Coordinator:
                     "wall_interval_s": stage_times.get(f.id),
                 }
             )
+        # roofline attribution: achieved GB/s / GFLOP/s per executed
+        # signature (cost_analysis() figures are per execution; execute_s
+        # sums every dispatch, so scale cost by the dispatch count), then
+        # the query-wide achieved bandwidth that feeds history baselines
+        # and the BANDWIDTH_REGRESSION sentinel
+        roof = None
+        roofline_sigs: list[dict] = []
+        total_bytes = 0.0
+        total_exec_s = 0.0
+        try:
+            for sig in sorted(exec_sigs):
+                ev = exec_sigs[sig]
+                n = int(ev.get("executes") or 0)
+                ex_s = float(ev.get("execute_s") or 0.0)
+                byts = float(ev.get("bytes_accessed") or 0.0) * n
+                flops = float(ev.get("flops") or 0.0) * n
+                if n <= 0 or ex_s <= 0.0 or not (byts or flops):
+                    continue
+                gbps = byts / ex_s / 1e9
+                if roof is None:
+                    roof = _roofline.device_roofline()
+                roofline_sigs.append({
+                    "signature": sig,
+                    "executes": n,
+                    "execute_ms": round(ex_s * 1e3, 3),
+                    "gflop_per_sec": round(flops / ex_s / 1e9, 3),
+                    "gb_per_sec": round(gbps, 3),
+                    "pct_of_roofline": round(
+                        _roofline.pct_of_roofline(gbps), 2
+                    ),
+                })
+                _roofline.observe_signature_gbps(gbps)
+                total_bytes += byts
+                total_exec_s += ex_s
+        except Exception:
+            traceback.print_exc()  # telemetry must never fail the query
+        device_gbps = (
+            round(total_bytes / total_exec_s / 1e9, 3)
+            if total_exec_s > 0 and total_bytes > 0 else None
+        )
+        # exchange-throughput accounting: per-stage link transfer rates
+        # from the tasks' per-producer {bytes, wall_ms, fetches} ledgers
+        exchange_stages: list[dict] = []
+        for sid in sorted(stage_links):
+            links = stage_links[sid]
+            tb = sum(ls["bytes"] for ls in links.values())
+            tw = sum(ls["wall_ms"] for ls in links.values())
+            exchange_stages.append({
+                "stage_id": sid,
+                "bytes": tb,
+                "wall_ms": round(tw, 3),
+                "fetches": sum(ls["fetches"] for ls in links.values()),
+                "gb_per_sec": (
+                    round(tb / (tw / 1e3) / 1e9, 3) if tw > 0 and tb
+                    else None
+                ),
+                "links": {u: dict(ls) for u, ls in sorted(links.items())},
+            })
         record["query_info"] = {
             "query_id": sm.query_id,
             "stages": stages,
@@ -2969,6 +3190,15 @@ class Coordinator:
             "compile_signatures": compile_sigs,
             "fallback_executions": fallback_execs,
             "fallback_reasons": fallback_reasons,
+            # observatory plane: query-wide achieved device bandwidth
+            # (rides into history for BANDWIDTH_REGRESSION baselines),
+            # per-signature roofline attribution, per-stage exchange rates
+            "device_gb_per_sec": device_gbps,
+            "roofline": (
+                {"device": roof, "signatures": roofline_sigs}
+                if roofline_sigs else None
+            ),
+            "exchange": exchange_stages,
             "wall_ms": round((time.perf_counter() - t_query0) * 1e3, 3),
             "output_rows": len(record["result"] or []),
             "task_retries": record.get("task_retries", 0),
@@ -3846,11 +4076,31 @@ def _make_handler(coord: Coordinator):
                             f"<td>{blocked}</td>"
                         )
 
+                    def _util_cells(w) -> str:
+                        # residency from the last heartbeat (/v1/info);
+                        # cpu rate from the node's time-series lane when
+                        # it is locally visible (in-process clusters
+                        # share the store; separate processes show "-")
+                        rss = (
+                            f"{int(w.rss_bytes) >> 20}"
+                            f"/{int(w.peak_rss_bytes or 0) >> 20}"
+                            if w.rss_bytes else "-"
+                        )
+                        lane = (
+                            _ts.snapshot(nodes=[w.url], series=["cpu_s"])
+                            .get(w.url) or {}
+                        ).get("cpu_s") or []
+                        cpu = (
+                            f"{lane[-1][1] / (_ts.STORE.sample_interval_s or 1.0):.2f}"
+                            if lane else "-"
+                        )
+                        return f"<td>{rss}</td><td>{cpu}</td>"
+
                     wrows = "".join(
                         f"<tr><td>{_html.escape(w.url)}</td>"
                         f"<td>{'alive' if w.alive else 'dead'}</td>"
                         f"<td>{now - w.last_seen:.1f}</td>"
-                        f"{_mem_cells(w)}</tr>"
+                        f"{_mem_cells(w)}{_util_cells(w)}</tr>"
                         for w in list(coord.workers.values())
                     )
                     # link matrix rows: only impaired links are rendered —
@@ -3911,7 +4161,8 @@ def _make_handler(coord: Coordinator):
                     f"<h3>workers ({nworkers})</h3>"
                     "<table><tr><th>url</th><th>state</th><th>seen (s)</th>"
                     "<th>mem reserved/cap (B)</th><th>revocable (B)</th>"
-                    "<th>blocked</th>"
+                    "<th>blocked</th><th>rss/peak (MiB)</th>"
+                    "<th>cpu (cores)</th>"
                     f"</tr>{wrows}</table>"
                     "<h3>impaired links</h3>"
                     "<table><tr><th>consumer</th><th>producer</th>"
@@ -4060,6 +4311,24 @@ def _make_handler(coord: Coordinator):
                         return self._send_json(404, {"error": "unknown query"})
                     info = dict(hist, expired=True)
                 return self._send_json(200, info)
+            if parts == ["v1", "timeseries"]:
+                # federated cluster view: this process's lanes plus every
+                # alive worker's own lane (per-node attribution survives
+                # both in-process and separate-process deployments)
+                try:
+                    since = float((params.get("since") or [None])[0])
+                except (TypeError, ValueError):
+                    since = None
+                names = [
+                    s for s in
+                    ((params.get("series") or [""])[0]).split(",") if s
+                ] or None
+                return self._send_json(
+                    200,
+                    {"node": coord.url, "stats": _ts.stats(),
+                     "nodes": coord._federated_timeseries(
+                         since=since, series=names)},
+                )
             if parts == ["v1", "flightrecorder"]:
                 # the coordinator is the collector: serve EVERY lane in
                 # this process's ring (in-process clusters share it; the

@@ -949,6 +949,44 @@ class Engine:
                 + (f" [persistent cache: {cache_txt}]" if cache_txt else "")
                 + (f" [{'; '.join(flags)}]" if flags else "")
             )
+        # roofline footer (coordinator roofline plane over
+        # utils/roofline.py): achieved bandwidth per executed signature
+        # as a fraction of what this device can actually sustain
+        roofline = info.get("roofline") or {}
+        dev = roofline.get("device") or {}
+        for s in roofline.get("signatures") or []:
+            line = (
+                f"-- roofline: {s.get('signature', '?')} "
+                f"{s.get('gflop_per_sec', 0.0):.3f} GFLOP/s, "
+                f"{s.get('gb_per_sec', 0.0):.3f} GB/s achieved over "
+                f"{s.get('execute_ms', 0.0):.1f} ms execute"
+            )
+            if dev.get("hbm_gbps"):
+                line += (
+                    f" ({s.get('pct_of_roofline', 0.0):.1f}% of "
+                    f"{dev['hbm_gbps']:g} GB/s "
+                    f"{dev.get('device_kind', '?')})"
+                )
+            text.append(line)
+        if info.get("device_gb_per_sec") is not None:
+            text.append(
+                f"-- device bandwidth: {info['device_gb_per_sec']:.3f} "
+                f"GB/s achieved query-wide"
+            )
+        # exchange footer (per-stage link accounting folded by the
+        # coordinator): what the exchange plane actually moved and how fast
+        for st in info.get("exchange") or []:
+            if not st.get("bytes"):
+                continue
+            line = (
+                f"-- exchange: stage {st.get('stage_id')} "
+                f"{st.get('bytes', 0)} B over {st.get('wall_ms', 0.0):.1f} "
+                f"ms ({st.get('fetches', 0)} fetches"
+            )
+            if st.get("gb_per_sec") is not None:
+                line += f", {st['gb_per_sec']:.3f} GB/s"
+            line += f", {len(st.get('links') or {})} link(s))"
+            text.append(line)
         return text
 
     def cache_invalidate(self, name: str) -> None:

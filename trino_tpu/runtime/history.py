@@ -201,6 +201,9 @@ class QueryHistoryStore:
             "compiles_p50": _pct(_vals("compile_count"), 0.5),
             "peak_bytes_p50": _pct(_vals("peak_memory_bytes"), 0.5),
             "rows_p50": _pct(_vals("rows"), 0.5),
+            # achieved device bandwidth (roofline plane): _vals skips runs
+            # with no figure, so eager-only plans never zero the baseline
+            "gb_per_sec_p50": round(_pct(_vals("device_gb_per_sec"), 0.5), 3),
         }
 
     # ---------------------------------------------------------------- read

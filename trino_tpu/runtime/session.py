@@ -409,8 +409,9 @@ PROPERTIES: dict[str, _Prop] = {
             "finish the coordinator scores the run against its planhash's "
             "rolling baseline and attaches typed anomalies "
             "(SLOW_VS_BASELINE, SPILL_REGRESSION, RETRY_STORM, "
-            "COMPILE_STORM) to QueryInfo / history / the EXPLAIN ANALYZE "
-            "footer; anomalous runs auto-trigger a post-mortem bundle",
+            "COMPILE_STORM, BANDWIDTH_REGRESSION) to QueryInfo / history "
+            "/ the EXPLAIN ANALYZE footer; anomalous runs auto-trigger a "
+            "post-mortem bundle",
             None,
         ),
         _Prop(
@@ -450,6 +451,21 @@ PROPERTIES: dict[str, _Prop] = {
             "COMPILE_STORM fires when a run's compile count exceeds "
             "max(2 x baseline p50, baseline p50 + this)",
             lambda v: v >= 1,
+        ),
+        _Prop(
+            "anomaly_bandwidth_factor", float, 2.0,
+            "BANDWIDTH_REGRESSION fires when a run's achieved device "
+            "GB/s (QueryInfo device_gb_per_sec, roofline plane) drops "
+            "below baseline p50 / this factor — an INVERTED comparison: "
+            "low bandwidth is the failure",
+            lambda v: v >= 1.0,
+        ),
+        _Prop(
+            "anomaly_bandwidth_min_gb_per_sec", float, 0.05,
+            "BANDWIDTH_REGRESSION baseline floor: plans whose baseline "
+            "p50 bandwidth sits below this never flag (tiny programs "
+            "live in scheduler-jitter noise, not the memory system)",
+            lambda v: v >= 0,
         ),
         _Prop(
             "postmortem_enabled", bool, True,

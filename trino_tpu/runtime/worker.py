@@ -68,6 +68,7 @@ from ..exec.compiler import LocalExecutor
 from ..plan.serde import plan_from_json
 from ..utils import flightrecorder as _fr
 from ..utils import metrics as _metrics
+from ..utils import timeseries as _ts
 from ..utils.tracing import Tracer, add_exporters_from_env
 from .disk import DiskExceeded, NodeDiskPool, guarded_write
 from .failure import Backoff, FaultInjector
@@ -251,6 +252,20 @@ class Worker:
             "trino_tpu_exchange_chunks_acked_total",
             "Buffer chunks freed by consumer acknowledge",
         )
+        # directional exchange totals (observatory plane): `in` = bytes
+        # this node fetched from producers, `out` = bytes it served to
+        # consumers — the same quantities the sampler turns into per-tick
+        # exchange_in_bytes / exchange_out_bytes lanes
+        self._m_exchange_bytes = self.metrics.counter(
+            "trino_tpu_exchange_bytes_total",
+            "Exchange bytes moved by this node, by direction "
+            "(in: fetched from producers; out: served to consumers)",
+            ("direction",),
+        )
+        # plain cumulative mirrors for the sampler's delta lanes (reading
+        # our own counter children back out would be clumsier)
+        self.exchange_bytes_in = 0
+        self.exchange_bytes_out = 0
         self._m_buffered = self.metrics.gauge(
             "trino_tpu_worker_buffered_bytes", "RAM-resident output bytes"
         )
@@ -330,7 +345,45 @@ class Worker:
                 old=old, new=new,
             ),
         )
+        # per-node utilization sampler (utils/timeseries.py): feeds this
+        # worker's lane of the process-global ring TSDB every
+        # timeseries.sample-interval-s; served at GET /v1/timeseries and
+        # federated into the coordinator's cluster view
+        self.sampler = _ts.Sampler(
+            self.url,
+            {
+                "cpu_s": _ts.cpu_seconds,
+                "rss_bytes": _ts.current_rss_bytes,
+                "mem_reserved_bytes": lambda: (
+                    self.memory_pool.snapshot()["reserved"]
+                    if self.memory_pool is not None else None
+                ),
+                "mem_capacity_bytes": lambda: (
+                    self.memory_pool.snapshot()["capacity"]
+                    if self.memory_pool is not None else None
+                ),
+                "disk_reserved_bytes": lambda: (
+                    self.disk_pool.snapshot()["reserved"]
+                    if self.disk_pool is not None else None
+                ),
+                "split_backlog": self._split_backlog,
+                "compile_inflight": _compile_inflight,
+                "exchange_in_bytes": lambda: self.exchange_bytes_in,
+                "exchange_out_bytes": lambda: self.exchange_bytes_out,
+                "links_impaired": lambda: len(self.link_health.impaired()),
+            },
+            deltas={"cpu_s", "exchange_in_bytes", "exchange_out_bytes"},
+        )
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def _split_backlog(self) -> int:
+        """Tasks accepted but not yet terminal — the worker-side queue
+        depth the sampler tracks as `split_backlog`."""
+        with self._lock:
+            return sum(
+                1 for t in self.tasks.values()
+                if t.state in ("RUNNING", "BLOCKED")
+            )
 
     def buffered_bytes(self) -> int:
         """Un-acknowledged output bytes parked in THIS worker's RAM (the
@@ -400,6 +453,7 @@ class Worker:
     def start(self) -> "Worker":
         self._thread.start()
         self._monitor.start()
+        self.sampler.start()  # no-op when the timeseries plane is disabled
         return self
 
     # ------------------------------------------------------------ lifecycle
@@ -420,6 +474,7 @@ class Worker:
         """Hard stop — the SIGKILL analogue the chaos tests use to exercise
         recovery paths: no drain, in-flight work is abandoned."""
         self._monitor_stop.set()
+        self.sampler.stop()
         self.httpd.shutdown()
         self.httpd.server_close()  # close the listening socket: connection
         # attempts fail fast instead of hanging in the kernel accept queue
@@ -752,6 +807,11 @@ class Worker:
         fetched_bytes = 0
         fetched_rows = 0
         remote_pages: dict[int, Page] = {}
+        # per-link accounting (observatory plane): bytes + transfer wall
+        # per producer URL, accrued inside _stream_fetch on productive
+        # responses only — rides task.stats so the coordinator can fold
+        # per-stage exchange GB/s without another round-trip
+        link_stats: dict[str, dict] = {}
         # exchange-wait attribution for the phase ledger: the whole source
         # loop is dominated by long-polling producers' buffers (the decode
         # riding along is noise next to the waits)
@@ -806,7 +866,8 @@ class Worker:
                         try:
                             blobs.extend(
                                 self._fetch_source(
-                                    u, t, buffer_id, ack=ack, req=req
+                                    u, t, buffer_id, ack=ack, req=req,
+                                    link_stats=link_stats,
                                 )
                             )
                         except RuntimeError as e:
@@ -831,6 +892,8 @@ class Worker:
             task.progress()  # each fetched source is a watchdog beat
         exchange_wait_ms = (_time.perf_counter() - t_fetch0) * 1e3
         self._m_fetched_bytes.inc(fetched_bytes)
+        self._m_exchange_bytes.labels("in").inc(fetched_bytes)
+        self.exchange_bytes_in += fetched_bytes
 
         # dynamic filtering: fetched build-side key domains narrow the
         # probe scans before upload (exec/dynfilter.py; reference:
@@ -919,6 +982,9 @@ class Worker:
             "exchange_wait_ms": round(exchange_wait_ms, 3),
             "spill_ms": round(spill_ms, 3),
             "compile_events": list(getattr(executor, "compile_events", [])),
+            # roofline plane: every signature this task dispatched, with
+            # execute wall and the profiler's flops/bytes per execution
+            "execute_events": _execute_events(executor),
             # fallback phase attribution (compile resilience plane): the
             # coordinator folds these into QueryInfo and the phase ledger
             "fallback": bool(getattr(executor, "fallback_events", None)),
@@ -932,6 +998,12 @@ class Worker:
             # the coordinator sees a partition the moment the first
             # affected task reports, not an interval later
             "links_impaired": self.link_health.impaired(),
+            # per-producer exchange accounting: {url: {bytes, wall_ms,
+            # fetches}} — the coordinator folds these into per-stage
+            # exchange GB/s and the `-- exchange:` footer
+            "exchange_links": {
+                u: dict(s) for u, s in link_stats.items()
+            },
         }
 
         if task.canceled:
@@ -1033,7 +1105,8 @@ class Worker:
 
     # ---------------------------------------------------- hedged source fetch
     def _fetch_source(
-        self, u: str, t: str, buffer_id: int, ack: bool, req: dict
+        self, u: str, t: str, buffer_id: int, ack: bool, req: dict,
+        link_stats: Optional[dict] = None,
     ) -> list[bytes]:
         """Fetch one producer buffer with link-health accounting, a
         propagated deadline budget, and — when the durable exchange is
@@ -1066,6 +1139,7 @@ class Worker:
             return _stream_fetch(
                 u, t, buffer_id, ack=ack, node=self.url, consumer=self.url,
                 health=lh, deadline_ts=deadline_ts, headroom_s=headroom_s,
+                link_stats=link_stats,
             )
         if lh.state(u) == DEAD and not lh.should_probe(u):
             # link breaker OPEN and the half-open window closed: skip the
@@ -1089,7 +1163,7 @@ class Worker:
                     u, t, buffer_id, ack=ack, node=self.url,
                     consumer=self.url, health=lh, deadline_ts=deadline_ts,
                     headroom_s=headroom_s, max_transient=rotate,
-                    abort=hedge_won.is_set,
+                    abort=hedge_won.is_set, link_stats=link_stats,
                 )
             except BaseException as e:
                 result["err"] = e
@@ -1165,6 +1239,8 @@ class Worker:
                     last = task.complete and token == len(chunks) - 1
                     task.bytes_served += len(blob)
                     self._m_served_bytes.inc(len(blob))
+                    self._m_exchange_bytes.labels("out").inc(len(blob))
+                    self.exchange_bytes_out += len(blob)
                     return 200, blob, {"X-Complete": "1" if last else "0"}
                 if task.complete:
                     # past the end: buffer exhausted
@@ -1270,6 +1346,34 @@ class Worker:
                 task.buffers = {}
 
 
+def _compile_inflight() -> int:
+    """Compiles running/queued in the process-global compile service —
+    the sampler's `compile_inflight` lane."""
+    from ..exec.compilesvc import SERVICE
+
+    return int(SERVICE.stats()["inflight"])
+
+
+def _execute_events(executor) -> dict[str, dict]:
+    """The executor's per-signature dispatch ledger joined with the
+    process-global profiler's flops / bytes-accessed for each signature
+    (cost_analysis() captured at compile time).  The join happens HERE —
+    in the process that compiled the program — so the coordinator's
+    roofline fold works across separate-process deployments too."""
+    from ..utils.profiler import PROFILER
+
+    out: dict[str, dict] = {}
+    for sig, ev in (getattr(executor, "execute_events", None) or {}).items():
+        rec = dict(ev)
+        prof = PROFILER.snapshot(sig) or {}
+        if prof.get("flops") is not None:
+            rec["flops"] = prof["flops"]
+        if prof.get("bytes_accessed") is not None:
+            rec["bytes_accessed"] = prof["bytes_accessed"]
+        out[sig] = rec
+    return out
+
+
 def _count_reasons(fallback_events: list) -> dict[str, int]:
     """reason -> count over an executor's fallback ledger (task stats)."""
     out: dict[str, int] = {}
@@ -1300,6 +1404,7 @@ def _stream_fetch(
     headroom_s: float = 0.5,
     max_transient: int = 0,
     abort=None,
+    link_stats: Optional[dict] = None,
 ) -> list[bytes]:
     """Token-sequenced consumption of one producer buffer with acknowledge —
     the reference's HttpPageBufferClient loop (sendGetResults:355, token+ack
@@ -1421,11 +1526,21 @@ def _stream_fetch(
             backoff.sleep()
             continue
         backoff.success()
-        if health is not None and (complete or (body and not no_data)):
+        productive = complete or (body and not no_data)
+        if health is not None and productive:
             # only PRODUCTIVE responses feed the latency EWMA/history: an
             # empty long-poll timeout measures the producer's compute
             # pace, not the link, and would poison the hedge quantile
             health.record_success(worker_url, time.monotonic() - t_req)
+        if link_stats is not None and productive:
+            # per-link throughput accounting (observatory plane): same
+            # productive-only rule as the health EWMA — long-poll idle
+            # time is the producer's pace, not link bandwidth
+            ls = link_stats.setdefault(
+                worker_url, {"bytes": 0, "wall_ms": 0.0, "fetches": 0}
+            )
+            ls["wall_ms"] += (time.monotonic() - t_req) * 1e3
+            ls["fetches"] += 1
         if body and not no_data:
             # end-to-end page integrity: verify the crc32 frame BEFORE the
             # chunk is appended or acked.  A corrupted frame is transient —
@@ -1442,6 +1557,9 @@ def _stream_fetch(
                 backoff.sleep()
                 continue
             blobs.append(body)
+            if link_stats is not None:
+                # entry exists: body-and-not-no_data implies productive
+                link_stats[worker_url]["bytes"] += len(body)
             token += 1
             if ack:  # free everything below the next token on the producer
                 _quiet_get(
@@ -1513,19 +1631,44 @@ def _make_handler(worker: Worker):
                     }
                 ).encode()
                 return self._send(200, body, "application/json")
+            # GET /v1/timeseries?since=&series= — this node's lane of the
+            # process-global ring TSDB (utils/timeseries.py); the
+            # coordinator federates every worker's answer into the
+            # cluster view
+            if parts == ["v1", "timeseries"]:
+                try:
+                    since = float(params.get("since") or 0.0) or None
+                except ValueError:
+                    since = None
+                series = params.get("series") or ""
+                names = [s for s in series.split(",") if s] or None
+                data = _ts.snapshot(
+                    nodes=[worker.url], series=names, since=since
+                )
+                body = json.dumps(
+                    {
+                        "node": worker.url,
+                        "stats": _ts.stats(),
+                        "series": data.get(worker.url) or {},
+                    }
+                ).encode()
+                return self._send(200, body, "application/json")
             if parts[:2] == ["v1", "info"]:
-                import resource as _res
-
                 by_query = worker.buffered_by_query()
+                # cluster memory visibility (reference: MemoryInfo polled
+                # by ClusterMemoryManager.java:92).  rss is CURRENT
+                # residency (/proc/self/statm) so memory governance can
+                # watch it fall after revocation; the lifetime high-water
+                # mark ships separately.  ru_maxrss is maintained at
+                # page-fault time and can lag statm by a few pages —
+                # clamp so sampled <= peak always holds on the wire.
+                rss = _ts.current_rss_bytes()
                 body = json.dumps(
                     {
                         "state": worker.state,
                         "tasks": len(worker.tasks),
-                        # cluster memory visibility (reference: MemoryInfo
-                        # polled by ClusterMemoryManager.java:92); ru_maxrss
-                        # is KiB on linux
-                        "rss_bytes": _res.getrusage(_res.RUSAGE_SELF).ru_maxrss
-                        * 1024,
+                        "rss_bytes": rss,
+                        "peak_rss_bytes": max(rss, _ts.peak_rss_bytes()),
                         "buffered_bytes": sum(by_query.values()),
                         "buffered_by_query": by_query,
                         # node pool reservations ride the heartbeat

@@ -24,6 +24,7 @@ def main(argv=None) -> int:
 
     from .runtime.config import (
         apply_flightrecorder_config,
+        apply_timeseries_config,
         load_catalogs,
         load_node_config,
     )
@@ -35,6 +36,7 @@ def main(argv=None) -> int:
 
     cfg = load_node_config(args.etc)
     apply_flightrecorder_config(cfg)
+    apply_timeseries_config(cfg)
     catalogs = load_catalogs(args.etc)
     names = catalogs.names()
     default_catalog = args.default_catalog or (names[0] if names else "memory")
